@@ -1,0 +1,35 @@
+"""Test harness config: force an 8-device virtual CPU mesh before any JAX use.
+
+Multi-chip TPU hardware is not available in CI; sharding/collective tests run
+against an 8-device virtual CPU backend, which exercises the same
+Mesh/shard_map/psum program structure the TPU path compiles. The host
+environment pre-imports jax (TPU tunnel registration), so the switch happens
+via jax.config — legal as long as no backend has been initialized yet.
+"""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+# Make the repo importable without installation (no-network image: pip install
+# of the package is not possible, tests import straight from the source tree).
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def clean_properties():
+    """Snapshot/restore the process property table around a test."""
+    from twtml_tpu import config
+
+    saved = dict(config._SYSTEM_PROPERTIES)
+    yield config._SYSTEM_PROPERTIES
+    config._SYSTEM_PROPERTIES.clear()
+    config._SYSTEM_PROPERTIES.update(saved)

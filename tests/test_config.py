@@ -1,0 +1,162 @@
+"""Config/CLI tests mirroring the reference's ConfArgumentsSuite
+(spark/src/test/scala/com/giorgioinf/twtml/spark/ConfArgumentsSuite.scala:41-142):
+defaults from reference.conf, OAuth routing into the property table, and
+long-flag + short-flag round-trips of every knob.
+"""
+
+import pytest
+
+from twtml_tpu import config as cfg
+from twtml_tpu.config import ConfArguments
+
+LIGHTNING_DEF = "http://public.lightning-viz.org"
+TWTWEB_DEF = "http://localhost:8888"
+
+MASTER = "local[4]"
+NAME = "twtml-tpu-test"
+LIGHTNING = "http://lightninghost"
+TWTWEB = "http://twtwebhost"
+SECONDS = 123
+STEP_SIZE = 0.01234
+NUM_ITERATIONS = 123
+MINI_BATCH_FRACTION = 1.23
+NUM_RETWEET_BEGIN = 1234
+NUM_RETWEET_END = 12345678
+NUM_TEXT_FEATURES = 123456
+CONSUMER_KEY = "1234567"
+CONSUMER_SECRET = "12345678"
+ACCESS_TOKEN = "123456789"
+ACCESS_TOKEN_SECRET = "1234567890"
+
+
+def twt(key):
+    return cfg.get_property("twitter4j.oauth." + key)
+
+
+@pytest.fixture()
+def isolated_env(tmp_path, monkeypatch):
+    """Defaults tests must not pick up a developer's application.conf/cwd."""
+    monkeypatch.delenv("TWTML_CONFIG", raising=False)
+    monkeypatch.chdir(tmp_path)
+
+
+def test_config_initialization_reference_conf(isolated_env):
+    conf = ConfArguments().setAppName(NAME)
+    assert conf.appName() == NAME
+    assert conf.lightning == LIGHTNING_DEF
+    assert conf.twtweb == TWTWEB_DEF
+
+
+def test_config_reference_conf_defaults(isolated_env):
+    conf = ConfArguments()
+    assert conf.seconds == 5
+    assert conf.stepSize == 0.005
+    assert conf.numIterations == 50
+    assert conf.miniBatchFraction == 1.0
+    assert conf.numRetweetBegin == 100
+    assert conf.numRetweetEnd == 1000
+    assert conf.numTextFeatures == 1000
+
+
+def test_config_long_arguments(clean_properties):
+    conf = ConfArguments().parse([
+        "--master", MASTER,
+        "--name", NAME,
+        "--consumerKey", CONSUMER_KEY,
+        "--consumerSecret", CONSUMER_SECRET,
+        "--accessToken", ACCESS_TOKEN,
+        "--accessTokenSecret", ACCESS_TOKEN_SECRET,
+        "--lightning", LIGHTNING,
+        "--twtweb", TWTWEB,
+        "--seconds", str(SECONDS),
+        "--stepSize", str(STEP_SIZE),
+        "--numIterations", str(NUM_ITERATIONS),
+        "--miniBatchFraction", str(MINI_BATCH_FRACTION),
+        "--numRetweetBegin", str(NUM_RETWEET_BEGIN),
+        "--numRetweetEnd", str(NUM_RETWEET_END),
+        "--numTextFeatures", str(NUM_TEXT_FEATURES),
+    ])
+    _assert_parsed(conf)
+
+
+def test_config_short_arguments(clean_properties):
+    conf = ConfArguments().parse([
+        "-m", MASTER,
+        "-n", NAME,
+        "-C", CONSUMER_KEY,
+        "-S", CONSUMER_SECRET,
+        "-A", ACCESS_TOKEN,
+        "-T", ACCESS_TOKEN_SECRET,
+        "-l", LIGHTNING,
+        "-w", TWTWEB,
+        "-s", str(SECONDS),
+        "-p", str(STEP_SIZE),
+        "-i", str(NUM_ITERATIONS),
+        "-b", str(MINI_BATCH_FRACTION),
+        "-B", str(NUM_RETWEET_BEGIN),
+        "-E", str(NUM_RETWEET_END),
+        "-f", str(NUM_TEXT_FEATURES),
+    ])
+    _assert_parsed(conf)
+
+
+def _assert_parsed(conf):
+    assert conf.master == MASTER
+    assert conf.appName() == NAME
+    assert twt("consumerKey") == CONSUMER_KEY
+    assert twt("consumerSecret") == CONSUMER_SECRET
+    assert twt("accessToken") == ACCESS_TOKEN
+    assert twt("accessTokenSecret") == ACCESS_TOKEN_SECRET
+    assert conf.lightning == LIGHTNING
+    assert conf.twtweb == TWTWEB
+    assert conf.seconds == SECONDS
+    assert conf.stepSize == STEP_SIZE
+    assert conf.numIterations == NUM_ITERATIONS
+    assert conf.miniBatchFraction == MINI_BATCH_FRACTION
+    assert conf.numRetweetBegin == NUM_RETWEET_BEGIN
+    assert conf.numRetweetEnd == NUM_RETWEET_END
+    assert conf.numTextFeatures == NUM_TEXT_FEATURES
+
+
+def test_help_exits_zero():
+    with pytest.raises(SystemExit) as exc:
+        ConfArguments().parse(["--help"])
+    assert exc.value.code == 0
+
+
+def test_unknown_flag_exits_nonzero():
+    with pytest.raises(SystemExit) as exc:
+        ConfArguments().parse(["--definitely-not-a-flag"])
+    assert exc.value.code == 1
+
+
+def test_extension_flags():
+    conf = ConfArguments().parse([
+        "--backend", "tpu",
+        "--source", "synthetic",
+        "--replayFile", "/tmp/tweets.jsonl",
+        "--l2Reg", "0.1",
+        "--dtype", "bfloat16",
+    ])
+    assert conf.backend == "tpu"
+    assert conf.source == "synthetic"
+    assert conf.replayFile == "/tmp/tweets.jsonl"
+    assert conf.l2Reg == 0.1
+    assert conf.dtype == "bfloat16"
+
+
+def test_local_shards_hint():
+    assert ConfArguments().parse(["-m", "local[4]"]).local_shards() == 4
+    assert ConfArguments().parse(["-m", "local[*]"]).local_shards() is None
+    assert ConfArguments().local_shards() is None
+
+
+def test_application_conf_layering(tmp_path, monkeypatch, clean_properties):
+    app_conf = tmp_path / "application.conf"
+    app_conf.write_text('seconds="9"\nconsumerKey="abc"\n')
+    monkeypatch.setenv("TWTML_CONFIG", str(app_conf))
+    conf = ConfArguments()
+    assert conf.seconds == 9
+    assert twt("consumerKey") == "abc"
+    # untouched keys keep reference defaults
+    assert conf.stepSize == 0.005

@@ -1,0 +1,25 @@
+"""twtml-tpu: a TPU-native streaming-ML framework.
+
+A ground-up re-design of the capabilities of ``QilinGu/twitter-stream-ml``
+(Spark Streaming + MLlib + Socko dashboard) as an idiomatic JAX/XLA stack:
+
+- ``twtml_tpu.config``     — layered config + CLI (reference: ConfArguments.scala)
+- ``twtml_tpu.features``   — tweet filter/featurizer (reference: MllibHelper.scala)
+- ``twtml_tpu.models``     — streaming learners: linear / logistic / k-means
+                             (reference: MLlib Streaming{LinearRegression,KMeans}WithSGD)
+- ``twtml_tpu.ops``        — device ops: sparse featurization, batch stats, pallas kernels
+- ``twtml_tpu.streaming``  — micro-batch streaming runtime (reference: Spark DStream)
+- ``twtml_tpu.parallel``   — mesh/sharding/collectives (reference: Spark treeAggregate/Netty)
+- ``twtml_tpu.telemetry``  — stats publishing (reference: SessionStats/WebClient/Lightning)
+- ``twtml_tpu.web``        — dashboard web server (reference: twtml-web Socko server)
+- ``twtml_tpu.checkpoint`` — model checkpoint/resume (absent in reference; upgrade)
+- ``twtml_tpu.utils``      — rounding/logging/tracing helpers
+
+Design notes: the reference's distributed runtime is Apache Spark (external JVM
+dependency); here the runtime is JAX itself — weights live resident in device
+HBM as donated jit state, the per-batch gradient reduce is a ``psum`` over the
+``data`` axis of a ``jax.sharding.Mesh`` (ICI), and multi-host scale-out uses
+``jax.distributed`` (DCN for process formation, ICI for collectives).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,4 @@
+from .rounding import round_half_up
+from .logging import get_logger
+
+__all__ = ["round_half_up", "get_logger"]

@@ -1,0 +1,36 @@
+"""Logging setup mirroring the reference's two-channel scheme:
+root WARN -> stderr, framework logger DEBUG-able
+(spark/src/main/resources/log4j.properties:1-17).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_CONFIGURED = False
+
+
+def _configure() -> None:
+    global _CONFIGURED
+    if _CONFIGURED:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+    )
+    root = logging.getLogger()
+    if not root.handlers:
+        root.addHandler(handler)
+        root.setLevel(logging.WARNING)
+    level = os.environ.get("TWTML_LOG", "INFO").upper()
+    logging.getLogger("twtml_tpu").setLevel(getattr(logging, level, logging.INFO))
+    _CONFIGURED = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    _configure()
+    if not name.startswith("twtml_tpu"):
+        name = "twtml_tpu." + name
+    return logging.getLogger(name)

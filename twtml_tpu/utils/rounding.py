@@ -1,0 +1,38 @@
+"""Half-up rounding, host and device versions.
+
+The reference rounds every reported metric and every prediction with
+BigDecimal HALF_UP (Utils.scala:4-6, used at LinearRegression.scala:57,63-65),
+i.e. ties round away from zero (2.5 -> 3, -2.5 -> -3), unlike Python's
+built-in banker's rounding. The device version is used inside jit so the MSE
+is computed over *rounded* predictions exactly like the reference (§2.5 of
+SURVEY.md: "MSE is computed on rounded predictions").
+"""
+
+from __future__ import annotations
+
+import decimal
+
+
+def round_half_up(x: float) -> float:
+    """Scalar host-side HALF_UP rounding (ties away from zero).
+
+    Uses decimal to match BigDecimal exactly on values adjacent to ties
+    (e.g. 0.49999999999999994 rounds to 0, where float ``floor(x+0.5)``
+    would give 1).
+    """
+    return float(
+        decimal.Decimal(x).quantize(decimal.Decimal(1), rounding=decimal.ROUND_HALF_UP)
+    )
+
+
+def jnp_round_half_up(x):
+    """Device-side HALF_UP rounding; safe under jit (no data-dependent flow).
+
+    Note: computed as ``floor(x+0.5)`` / ``ceil(x-0.5)`` in device floats, which
+    differs from BigDecimal on tie-adjacent values below float resolution
+    (e.g. 0.49999999999999994). Acceptable inside the jit metric path; host-side
+    reporting uses the exact ``round_half_up`` above.
+    """
+    import jax.numpy as jnp
+
+    return jnp.where(x >= 0, jnp.floor(x + 0.5), jnp.ceil(x - 0.5))
