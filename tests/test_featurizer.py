@@ -1,0 +1,114 @@
+"""Featurizer golden tests (reference semantics: MllibHelper.scala:42-95).
+
+Fixture tweets in tests/data/tweets.jsonl cover: in-range retweets, out-of-range
+(3 and 50000), boundary values (100, 1000 — inclusive per
+MllibHelper.scala:84-87), non-retweets, emoji/accents, and timestamp_ms parsing.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from twtml_tpu.features import Featurizer, Status
+from twtml_tpu.features.hashing import hashing_tf_counts, char_bigrams
+
+DATA = os.path.join(os.path.dirname(__file__), "data", "tweets.jsonl")
+
+
+@pytest.fixture()
+def statuses():
+    with open(DATA, encoding="utf-8") as fh:
+        return [Status.from_json(json.loads(line)) for line in fh if line.strip()]
+
+
+@pytest.fixture()
+def feat():
+    return Featurizer(now_ms=1785320000000)  # fixed clock for determinism
+
+
+def test_filtrate(statuses, feat):
+    kept = [s for s in statuses if feat.filtrate(s)]
+    # in range: 250, 500, 100 (boundary), 1000 (boundary), 777, 980
+    assert [s.retweeted_status.retweet_count for s in kept] == [250, 500, 100, 1000, 777, 980]
+
+
+def test_filtrate_rejects_non_retweets(statuses, feat):
+    plain = [s for s in statuses if not s.is_retweet]
+    assert len(plain) == 2
+    assert all(not feat.filtrate(s) for s in plain)
+
+
+def test_label_is_original_retweet_count(statuses, feat):
+    s = statuses[0]
+    _, _, label = feat.featurize(s)
+    assert label == 250.0
+
+
+def test_text_features_hash_original_lowercased(statuses, feat):
+    s = statuses[0]  # original text: "Breaking news from the summit today!"
+    counts = feat.featurize_text(s)
+    expected = hashing_tf_counts(
+        char_bigrams("breaking news from the summit today!"), 1000
+    )
+    assert counts == expected
+    # Never hashes the RT-wrapper text.
+    wrapper = hashing_tf_counts(
+        char_bigrams("rt @alice: breaking news from the summit today!"), 1000
+    )
+    assert counts != wrapper
+
+
+def test_numeric_feature_scaling(statuses, feat):
+    s = statuses[0]
+    nums = feat.featurize_numbers(s)
+    orig = s.retweeted_status
+    assert nums[0] == pytest.approx(50000 * 1e-12)
+    assert nums[1] == pytest.approx(1200 * 1e-12)
+    assert nums[2] == pytest.approx(900 * 1e-12)
+    age_ms = 1785320000000 - orig.created_at_ms
+    assert age_ms > 0
+    assert nums[3] == pytest.approx(age_ms * 1e-14, rel=1e-6)
+
+
+def test_timestamp_ms_parsing(statuses):
+    s = statuses[7]
+    assert s.retweeted_status.created_at_ms == 1785315612000
+
+
+def test_created_at_parsing(statuses):
+    # "Mon Jul 27 09:00:00 +0000 2026"
+    assert statuses[0].retweeted_status.created_at_ms == 1785142800000
+
+
+def test_num_text_features_takes_effect():
+    """The reference's reset() shadows its own fields (MllibHelper.scala:27-29)
+    so --numTextFeatures never reaches the hasher; ours must apply it."""
+    big = Featurizer(num_text_features=2**18, now_ms=0)
+    s = Status(retweeted_status=Status(text="Deep learning on TPUs", retweet_count=500))
+    counts = big.featurize_text(s)
+    assert all(0 <= idx < 2**18 for idx in counts)
+    assert big.num_features == 2**18 + 4
+
+
+def test_featurize_batch_padding(statuses, feat):
+    batch = feat.featurize_batch(statuses)
+    assert batch.num_valid == 6
+    # padded to power-of-two bucket
+    assert batch.token_idx.shape[0] == 8
+    assert batch.token_idx.shape == batch.token_val.shape
+    assert batch.numeric.shape == (8, 4)
+    assert batch.mask.tolist() == [1, 1, 1, 1, 1, 1, 0, 0]
+    # padded token slots are zero-valued so scatter-adds are no-ops
+    assert batch.token_val[batch.mask == 0].sum() == 0
+
+
+def test_accent_normalization_optional():
+    s = Status(retweeted_status=Status(text="café", retweet_count=500))
+    raw = Featurizer(now_ms=0).featurize_text(s)
+    norm = Featurizer(now_ms=0, normalize_accents=True).featurize_text(s)
+    expected_norm = hashing_tf_counts(char_bigrams("cafe"), 1000)
+    assert norm == expected_norm
+    assert raw == hashing_tf_counts(char_bigrams("café"), 1000)
+    assert raw != norm

@@ -1,0 +1,55 @@
+"""Hash parity tests: java_string_hashcode must equal JVM String.hashCode
+(the basis of MLlib HashingTF indexing, MllibHelper.scala:18,54).
+Expected values are literals computed on a JVM."""
+
+from twtml_tpu.features.hashing import (
+    char_bigrams,
+    hashing_tf_counts,
+    java_string_hashcode,
+    non_negative_mod,
+)
+
+
+def test_known_java_hashcodes():
+    assert java_string_hashcode("") == 0
+    assert java_string_hashcode("a") == 97
+    assert java_string_hashcode("ab") == 3105
+    assert java_string_hashcode("he") == 3325
+    assert java_string_hashcode("hello") == 99162322
+    # The canonical overflow example: known JVM value (Integer.MIN_VALUE).
+    assert java_string_hashcode("polygenelubricants") == -2147483648
+
+
+def test_surrogate_pair_hashing():
+    # U+1F600 encodes as surrogates D83D DE00 on the JVM:
+    # h = 0xD83D * 31 + 0xDE00 = 1772899
+    assert java_string_hashcode("\U0001f600") == 1772899
+
+
+def test_negative_hash_maps_nonnegative():
+    h = java_string_hashcode("polygenelubricants")  # == Integer.MIN_VALUE
+    assert h < 0
+    idx = non_negative_mod(h, 1000)
+    assert 0 <= idx < 1000
+    # Java: ((-2147483648 % 1000) + 1000) % 1000 == 352
+    assert idx == 352
+
+
+def test_char_bigrams_sliding_semantics():
+    # Scala "abcd".sliding(2) -> ab, bc, cd
+    assert char_bigrams("abcd") == ["ab", "bc", "cd"]
+    # Shorter-than-window strings yield themselves (Scala sliding behavior).
+    assert char_bigrams("a") == ["a"]
+    assert char_bigrams("") == []
+
+
+def test_hashing_tf_counts_accumulate():
+    counts = hashing_tf_counts(["ab", "ab", "he"], 1000)
+    assert counts[3105 % 1000] == 2.0
+    assert counts[3325 % 1000] == 1.0
+
+
+def test_collisions_accumulate():
+    # Two distinct terms forced onto the same index with tiny mod.
+    counts = hashing_tf_counts(["a", "b"], 1)
+    assert counts == {0: 2.0}

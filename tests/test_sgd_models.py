@@ -1,0 +1,239 @@
+"""Learner-core parity tests.
+
+The numpy oracle below independently implements MLlib 1.6's
+GradientDescent.runMiniBatchSGD semantics (per-iteration step stepSize/√i,
+SimpleUpdater/SquaredL2Updater, convergence tolerance on successive weight
+vectors) so the fused XLA step can be checked against it, plus the
+predict-then-train ordering and masked statistics of the reference app
+(LinearRegression.scala:53-86).
+"""
+
+import numpy as np
+import pytest
+
+from twtml_tpu.features.batch import FeatureBatch
+from twtml_tpu.models import (
+    StreamingKMeans,
+    StreamingLinearRegressionWithSGD,
+    StreamingLogisticRegressionWithSGD,
+)
+
+RNG = np.random.default_rng(7)
+F_TEXT = 16
+F = F_TEXT + 4
+
+
+def random_batch(n=12, pad_to=16, tokens=6, label_scale=100.0):
+    token_idx = RNG.integers(0, F_TEXT, size=(pad_to, tokens)).astype(np.int32)
+    token_val = RNG.integers(1, 4, size=(pad_to, tokens)).astype(np.float32)
+    numeric = RNG.normal(size=(pad_to, 4)).astype(np.float32) * 0.1
+    label = (RNG.uniform(0.2, 1.0, size=(pad_to,)) * label_scale).astype(np.float32)
+    mask = np.zeros((pad_to,), dtype=np.float32)
+    mask[:n] = 1.0
+    # zero out padding rows like the real featurizer does
+    token_val[n:] = 0
+    token_idx[n:] = 0
+    numeric[n:] = 0
+    label[n:] = 0
+    return FeatureBatch(token_idx, token_val, numeric, label, mask)
+
+
+def densify(batch):
+    b = batch.token_idx.shape[0]
+    X = np.zeros((b, F), dtype=np.float64)
+    for i in range(b):
+        for j in range(batch.token_idx.shape[1]):
+            X[i, batch.token_idx[i, j]] += batch.token_val[i, j]
+    X[:, F_TEXT:] = batch.numeric
+    return X
+
+
+def oracle_sgd(X, y, w0, num_iter, step, l2=0.0, tol=0.001):
+    """Independent MLlib GradientDescent oracle (fraction 1.0)."""
+    w = w0.astype(np.float64).copy()
+    for i in range(1, num_iter + 1):
+        diff = X @ w - y
+        grad = X.T @ diff / len(y)
+        eta = step / np.sqrt(i)
+        w_new = w * (1.0 - eta * l2) - eta * grad
+        converged = tol > 0 and np.linalg.norm(w_new - w) < tol * max(
+            np.linalg.norm(w_new), 1.0
+        )
+        w = w_new
+        if converged:
+            break
+    return w
+
+
+def valid(batch):
+    return batch.mask.astype(bool)
+
+
+class TestLinearParity:
+    def test_weights_match_oracle(self):
+        batch = random_batch()
+        model = StreamingLinearRegressionWithSGD(
+            num_text_features=F_TEXT, num_iterations=50, step_size=0.005
+        )
+        model.step(batch)
+        X = densify(batch)[valid(batch)]
+        y = batch.label[valid(batch)].astype(np.float64)
+        w_expect = oracle_sgd(X, y, np.zeros(F), 50, 0.005)
+        np.testing.assert_allclose(model.latest_weights, w_expect, rtol=2e-4, atol=1e-6)
+
+    def test_l2_regularization_matches_oracle(self):
+        batch = random_batch()
+        model = StreamingLinearRegressionWithSGD(
+            num_text_features=F_TEXT, num_iterations=25, step_size=0.005, l2_reg=0.1
+        )
+        model.step(batch)
+        X = densify(batch)[valid(batch)]
+        y = batch.label[valid(batch)].astype(np.float64)
+        w_expect = oracle_sgd(X, y, np.zeros(F), 25, 0.005, l2=0.1)
+        np.testing.assert_allclose(model.latest_weights, w_expect, rtol=2e-4, atol=1e-6)
+
+    def test_sparse_path_matches_dense_path(self):
+        batch = random_batch()
+        dense = StreamingLinearRegressionWithSGD(
+            num_text_features=F_TEXT, num_iterations=20, step_size=0.005,
+            use_sparse=False,
+        )
+        sparse = StreamingLinearRegressionWithSGD(
+            num_text_features=F_TEXT, num_iterations=20, step_size=0.005,
+            use_sparse=True,
+        )
+        out_d = dense.step(batch)
+        out_s = sparse.step(batch)
+        np.testing.assert_allclose(
+            dense.latest_weights, sparse.latest_weights, rtol=1e-5, atol=1e-7
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_d.predictions), np.asarray(out_s.predictions), atol=1e-5
+        )
+
+    def test_predict_then_train_ordering(self):
+        """First batch must be scored with the zero init weights."""
+        batch = random_batch()
+        model = StreamingLinearRegressionWithSGD(num_text_features=F_TEXT)
+        out = model.step(batch)
+        assert np.all(np.asarray(out.predictions) == 0.0)
+        y = batch.label[valid(batch)]
+        assert float(out.mse) == pytest.approx(float(np.mean(y.astype(np.float64) ** 2)), rel=1e-5)
+        # and training did move the weights
+        assert np.abs(model.latest_weights).sum() > 0
+
+    def test_stats_match_numpy(self):
+        batch = random_batch()
+        model = StreamingLinearRegressionWithSGD(num_text_features=F_TEXT)
+        model.step(batch)  # move off zero weights
+        out = model.step(batch)
+        y = batch.label[valid(batch)].astype(np.float64)
+        X = densify(batch)[valid(batch)]
+        # reproduce predictions with the pre-step weights: re-run oracle once
+        w_before = oracle_sgd(X, y, np.zeros(F), 50, 0.005)
+        preds = X @ w_before
+        rounded = np.where(preds >= 0, np.floor(preds + 0.5), np.ceil(preds - 0.5))
+        assert float(out.count) == len(y)
+        assert float(out.mse) == pytest.approx(float(np.mean((y - rounded) ** 2)), rel=2e-3)
+        assert float(out.real_stdev) == pytest.approx(float(np.std(y)), rel=1e-4)
+        assert float(out.pred_stdev) == pytest.approx(float(np.std(rounded)), rel=2e-3)
+
+    def test_empty_batch_no_update(self):
+        batch = random_batch(n=0)
+        model = StreamingLinearRegressionWithSGD(num_text_features=F_TEXT)
+        out = model.step(batch)
+        assert float(out.count) == 0.0
+        assert np.all(model.latest_weights == 0.0)
+
+    def test_padding_rows_do_not_leak(self):
+        """Same valid rows, different padding sizes → same weights."""
+        small = random_batch(n=8, pad_to=8)
+        big = FeatureBatch(
+            np.pad(small.token_idx, ((0, 24), (0, 0))),
+            np.pad(small.token_val, ((0, 24), (0, 0))),
+            np.pad(small.numeric, ((0, 24), (0, 0))),
+            np.pad(small.label, (0, 24)),
+            np.pad(small.mask, (0, 24)),
+        )
+        m1 = StreamingLinearRegressionWithSGD(num_text_features=F_TEXT)
+        m2 = StreamingLinearRegressionWithSGD(num_text_features=F_TEXT)
+        m1.step(small)
+        m2.step(big)
+        np.testing.assert_allclose(m1.latest_weights, m2.latest_weights, rtol=1e-6)
+
+    def test_mini_batch_fraction_subsamples(self):
+        batch = random_batch()
+        model = StreamingLinearRegressionWithSGD(
+            num_text_features=F_TEXT, num_iterations=10, mini_batch_fraction=0.5
+        )
+        out = model.step(batch)
+        assert float(out.count) == batch.num_valid  # stats use the full batch
+        assert np.abs(model.latest_weights).sum() > 0
+
+
+class TestLogistic:
+    def test_learns_separable_data(self):
+        n, pad = 32, 32
+        token_idx = np.zeros((pad, 2), dtype=np.int32)
+        token_val = np.zeros((pad, 2), dtype=np.float32)
+        labels = np.zeros((pad,), dtype=np.float32)
+        for i in range(n):
+            cls = i % 2
+            labels[i] = cls
+            token_idx[i, 0] = 1 if cls else 2
+            token_val[i, 0] = 1.0
+        batch = FeatureBatch(
+            token_idx,
+            token_val,
+            np.zeros((pad, 4), np.float32),
+            labels,
+            np.ones((pad,), np.float32),
+        )
+        model = StreamingLogisticRegressionWithSGD(
+            num_text_features=F_TEXT, num_iterations=100, step_size=1.0,
+            convergence_tol=0.0,
+        )
+        for _ in range(5):
+            out = model.step(batch)
+        preds = np.asarray(out.predictions)
+        assert np.mean(preds == labels) > 0.95
+        assert set(np.unique(preds)).issubset({0.0, 1.0})
+
+
+class TestStreamingKMeans:
+    def test_centers_converge_to_cluster_means(self):
+        pts = np.concatenate(
+            [
+                RNG.normal(loc=(0, 0), scale=0.05, size=(50, 2)),
+                RNG.normal(loc=(10, 10), scale=0.05, size=(50, 2)),
+            ]
+        ).astype(np.float32)
+        model = StreamingKMeans().set_k(2).set_initial_centers(
+            [[1.0, 1.0], [9.0, 9.0]], [0.0, 0.0]
+        )
+        assign = model.update(pts)
+        centers = model.latest_centers
+        centers = centers[np.argsort(centers[:, 0])]
+        np.testing.assert_allclose(centers[0], pts[:50].mean(0), atol=0.05)
+        np.testing.assert_allclose(centers[1], pts[50:].mean(0), atol=0.05)
+        assert len(np.unique(assign)) == 2
+
+    def test_half_life_decay_factor(self):
+        model = StreamingKMeans().set_half_life(5, "batches")
+        assert model.decay_factor == pytest.approx(0.5 ** (1 / 5))
+
+    def test_full_decay_forgets_history(self):
+        """decayFactor=0 → centers become this batch's cluster means."""
+        model = StreamingKMeans(k=1, decay_factor=0.0).set_initial_centers(
+            [[100.0, 100.0]], [1000.0]
+        )
+        pts = np.array([[1.0, 1.0], [3.0, 3.0]], np.float32)
+        model.update(pts)
+        np.testing.assert_allclose(model.latest_centers[0], [2.0, 2.0], atol=1e-5)
+
+    def test_predict(self):
+        model = StreamingKMeans(k=2).set_initial_centers(
+            [[0.0, 0.0], [10.0, 10.0]], [1.0, 1.0]
+        )
+        out = model.predict(np.array([[1.0, 0.0], [9.0, 9.0]], np.float32))
+        assert out.tolist() == [0, 1]

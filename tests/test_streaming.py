@@ -1,0 +1,127 @@
+"""Streaming runtime tests: micro-batcher, source supervision, replay mode,
+and the end-to-end linear-regression app on the tweet fixture (the reference
+never tested this layer — SURVEY.md §4 notes the gap; BASELINE config #1 is
+exactly this replayed-tweet run)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from twtml_tpu.config import ConfArguments
+from twtml_tpu.features.featurizer import Featurizer, Status
+from twtml_tpu.streaming.context import StreamingContext
+from twtml_tpu.streaming.sources import QueueSource, ReplayFileSource, Source
+
+DATA = os.path.join(os.path.dirname(__file__), "data", "tweets.jsonl")
+
+
+def rt(label=500, text="some tweet text"):
+    return Status(text="RT", retweeted_status=Status(text=text, retweet_count=label))
+
+
+def test_wall_clock_batching():
+    src = QueueSource()
+    ssc = StreamingContext(batch_interval=0.1)
+    feat = Featurizer(now_ms=0)
+    seen = []
+    ssc.source_stream(src, feat).foreach_batch(
+        lambda batch, t: seen.append(batch.num_valid)
+    )
+    ssc.start()
+    for _ in range(3):
+        src.push(rt())
+    time.sleep(0.25)
+    src.close()
+    ssc.await_termination(timeout=2)
+    ssc.stop()
+    assert sum(seen) == 3
+    assert len(seen) >= 1
+
+
+def test_outputs_fire_in_registration_order():
+    src = QueueSource()
+    ssc = StreamingContext(batch_interval=0.05)
+    order = []
+    stream = ssc.source_stream(src, Featurizer(now_ms=0))
+    stream.foreach_batch(lambda b, t: order.append("stats"))
+    stream.foreach_batch(lambda b, t: order.append("train"))
+    src.push(rt())
+    src.close()
+    ssc.start()
+    ssc.await_termination(timeout=2)
+    ssc.stop()
+    assert order[:2] == ["stats", "train"]
+
+
+def test_source_supervision_restarts():
+    class Flaky(Source):
+        name = "flaky"
+        attempts = 0
+
+        def produce(self):
+            Flaky.attempts += 1
+            if Flaky.attempts == 1:
+                raise RuntimeError("simulated receiver crash")
+            yield rt()
+
+    src = Flaky(restart_backoff=0.01)
+    got = []
+    src.start(got.append)
+    deadline = time.time() + 2
+    while not src.exhausted and time.time() < deadline:
+        time.sleep(0.01)
+    src.stop()
+    assert Flaky.attempts == 2
+    assert len(got) == 1
+
+
+def test_source_gives_up_after_max_restarts():
+    class Dead(Source):
+        name = "dead"
+
+        def produce(self):
+            raise RuntimeError("always broken")
+            yield  # pragma: no cover
+
+    src = Dead(max_restarts=2, restart_backoff=0.01)
+    src.start(lambda s: None)
+    deadline = time.time() + 2
+    while not src.exhausted and time.time() < deadline:
+        time.sleep(0.01)
+    assert src.exhausted
+    src.stop()
+
+
+def test_replay_run_to_completion():
+    src = ReplayFileSource(DATA)
+    ssc = StreamingContext()
+    feat = Featurizer(now_ms=0)
+    batches = []
+    ssc.source_stream(src, feat).foreach_batch(
+        lambda batch, t: batches.append(batch)
+    )
+    n = ssc.run_to_completion()
+    assert n == len(batches) >= 1
+    assert sum(b.num_valid for b in batches) == 6  # 6 in-range retweets in fixture
+
+
+def test_e2e_linear_app_on_replay(capsys):
+    from twtml_tpu.apps.linear_regression import run
+
+    conf = ConfArguments().parse([
+        "--source", "replay",
+        "--replayFile", DATA,
+        "--seconds", "1",
+        "--backend", "cpu",
+        "--lightning", "http://127.0.0.1:9",  # closed port: exercises Try paths
+        "--twtweb", "http://127.0.0.1:9",
+    ])
+    totals = run(conf)
+    assert totals["count"] == 6
+    assert totals["batches"] >= 1
+    out = capsys.readouterr().out
+    assert "count: 6" in out
+    assert "mse:" in out

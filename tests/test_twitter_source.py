@@ -1,0 +1,52 @@
+"""TwitterSource tests via connect_fn injection (no egress in CI)."""
+
+import json
+import time
+
+import pytest
+
+from twtml_tpu import config as cfg
+from twtml_tpu.streaming.twitter import TwitterSource
+
+
+def fake_stream():
+    yield json.dumps({
+        "text": "RT @x: hello world",
+        "retweeted_status": {
+            "text": "hello world",
+            "retweet_count": 500,
+            "user": {"followers_count": 10},
+        },
+    })
+    yield ""  # keep-alive
+    yield "not json"
+    yield json.dumps({"delete": {"status": {"id": 1}}})  # notice, no text
+    yield json.dumps({"text": "plain tweet", "user": {}})
+
+
+def test_parses_and_skips_noise():
+    src = TwitterSource({}, connect_fn=fake_stream)
+    got = []
+    src.start(got.append)
+    deadline = time.time() + 2
+    while not src.exhausted and time.time() < deadline:
+        time.sleep(0.01)
+    src.stop()
+    assert len(got) == 2
+    assert got[0].is_retweet and got[0].retweeted_status.retweet_count == 500
+    assert got[1].text == "plain tweet"
+
+
+def test_from_properties_requires_credentials(clean_properties):
+    for k in list(cfg._SYSTEM_PROPERTIES):
+        cfg._SYSTEM_PROPERTIES.pop(k)
+    with pytest.raises(SystemExit) as exc:
+        TwitterSource.from_properties()
+    assert "credentials missing" in str(exc.value)
+
+
+def test_from_properties_with_credentials(clean_properties):
+    for k in ("consumerKey", "consumerSecret", "accessToken", "accessTokenSecret"):
+        cfg.set_property("twitter4j.oauth." + k, "x" * 10)
+    src = TwitterSource.from_properties(connect_fn=fake_stream)
+    assert src.credentials["twitter4j.oauth.consumerKey"] == "x" * 10

@@ -1,0 +1,131 @@
+"""Streaming linear-regression entry point — the flagship application.
+
+Wires the same pipeline as the reference's ``LinearRegression.main``
+(LinearRegression.scala:12-91): config → session stats → featurizer → model →
+streaming context → source → per-batch predict/stats/train → run. The
+reference's two registered outputs (stats ``foreachRDD`` then ``trainOn``)
+collapse into one fused device step that scores with pre-update weights and
+trains in the same XLA program.
+
+Run: ``python -m twtml_tpu.apps.linear_regression --source replay \
+      --replayFile tests/data/tweets.jsonl --seconds 1``
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from ..config import ConfArguments
+from ..features.featurizer import Featurizer
+from ..models.linear import StreamingLinearRegressionWithSGD
+from ..streaming.context import StreamingContext
+from ..streaming.sources import ReplayFileSource, Source, SyntheticSource
+from ..telemetry.session_stats import SessionStats
+from ..utils import get_logger, round_half_up
+
+log = get_logger("apps.linear")
+
+
+def select_backend(conf) -> None:
+    """--backend {auto,tpu,cpu}: auto keeps jax's platform choice (TPU when
+    attached); cpu forces the host backend (the reference's local[*] analog,
+    ConfArguments.scala:54-56)."""
+    import jax
+
+    if conf.backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        shards = conf.local_shards()
+        if shards:
+            jax.config.update("jax_num_cpu_devices", shards)
+    elif conf.backend == "tpu":
+        import jax
+
+        kinds = {d.platform for d in jax.devices()}
+        if "cpu" in kinds and len(kinds) == 1:
+            raise RuntimeError("--backend tpu requested but only CPU devices present")
+
+
+def build_source(conf) -> Source:
+    if conf.source == "replay":
+        if not conf.replayFile:
+            raise SystemExit("--source replay requires --replayFile <path.jsonl>")
+        return ReplayFileSource(conf.replayFile, speed=conf.replaySpeed)
+    if conf.source == "synthetic":
+        return SyntheticSource(rate=conf.replaySpeed or 0.0)
+    if conf.source == "twitter":
+        from ..streaming.twitter import TwitterSource
+
+        return TwitterSource.from_properties()
+    raise SystemExit(f"unknown --source {conf.source!r}")
+
+
+def run(conf: ConfArguments, max_batches: int = 0) -> dict:
+    log.info("Initializing session stats...")
+    session = SessionStats(conf).open()
+
+    log.info("Initializing TPU-native streaming model...")
+    select_backend(conf)
+    featurizer = Featurizer.from_conf(conf)
+    model = StreamingLinearRegressionWithSGD.from_conf(conf)
+
+    log.info("Initializing streaming context... %s sec/batch", conf.seconds)
+    ssc = StreamingContext(batch_interval=conf.seconds)
+    stream = ssc.source_stream(
+        build_source(conf), featurizer, row_bucket=conf.batchBucket
+    )
+
+    totals = {"count": 0, "batches": 0}
+
+    def on_batch(batch, _batch_time) -> None:
+        if batch.num_valid == 0:
+            log.debug("batch: 0")
+            return
+        out = model.step(batch)
+        b = int(out.count)
+        totals["count"] += b
+        totals["batches"] += 1
+        mse = round_half_up(float(out.mse))
+        real_stdev = round_half_up(float(out.real_stdev))
+        pred_stdev = round_half_up(float(out.pred_stdev))
+        valid = batch.mask.astype(bool)
+        real = batch.label[valid].astype(np.float64)
+        pred = np.asarray(out.predictions)[valid].astype(np.float64)
+        # the reference's debug channel (LinearRegression.scala:67-74)
+        print(
+            f"count: {totals['count']}  batch: {b}  mse: {mse}  "
+            f"stdev (real, pred): ({int(real_stdev)}, {int(pred_stdev)})",
+            flush=True,
+        )
+        session.update(
+            totals["count"], b, mse, real_stdev, pred_stdev, real, pred
+        )
+        if max_batches and totals["batches"] >= max_batches:
+            ssc._stop.set()
+
+    stream.foreach_batch(on_batch)
+
+    log.info("Starting the streaming computation...")
+    ssc.start()
+    try:
+        ssc.await_termination()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        ssc.stop()
+    return totals
+
+
+def main(argv=None) -> None:
+    conf = (
+        ConfArguments()
+        .setAppName("twitter-stream-ml-linear-regression")
+        .parse(list(sys.argv[1:] if argv is None else argv))
+    )
+    totals = run(conf)
+    log.info("done: %s tweets in %s batches", totals["count"], totals["batches"])
+
+
+if __name__ == "__main__":
+    main()

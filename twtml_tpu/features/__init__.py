@@ -1,0 +1,13 @@
+from .hashing import java_string_hashcode, hashing_tf_counts, char_bigrams
+from .featurizer import Status, Featurizer
+from .batch import FeatureBatch, pad_feature_batch
+
+__all__ = [
+    "java_string_hashcode",
+    "hashing_tf_counts",
+    "char_bigrams",
+    "Status",
+    "Featurizer",
+    "FeatureBatch",
+    "pad_feature_batch",
+]

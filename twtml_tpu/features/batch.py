@@ -1,0 +1,83 @@
+"""Fixed-shape padded micro-batches — the XLA-facing data contract.
+
+The reference hands MLlib a per-tweet ``LabeledPoint`` with a 1004-dim sparse
+vector (MllibHelper.scala:73-82). XLA wants static shapes, so a micro-batch
+here is a struct of padded arrays: hashed token indices/counts per tweet
+(sparse text features), the 4 dense numeric features, labels, and a validity
+mask. Batch row counts and token counts are padded up to bucket sizes so a
+stream of varying batch sizes reuses a small set of compiled programs instead
+of recompiling per batch (SURVEY.md §7 "hard parts" (a)).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+NUM_NUMBER_FEATURES = 4  # MllibHelper.scala:13
+
+
+class FeatureBatch(NamedTuple):
+    """One padded micro-batch. All arrays are host numpy until the learner
+    moves them to device; as a NamedTuple it is automatically a JAX pytree.
+
+    Shapes (B = padded rows, L = padded tokens/tweet):
+      token_idx: int32  [B, L] — hashed bigram indices into [0, numTextFeatures)
+      token_val: float32[B, L] — term-frequency counts (0 where padded)
+      numeric:   float32[B, 4] — scaled followers/favourites/friends/age feats
+      label:     float32[B]    — retweet count of the retweeted status
+      mask:      float32[B]    — 1.0 for real rows, 0.0 for padding
+    """
+
+    token_idx: np.ndarray
+    token_val: np.ndarray
+    numeric: np.ndarray
+    label: np.ndarray
+    mask: np.ndarray
+
+    @property
+    def num_valid(self) -> int:
+        return int(self.mask.sum())
+
+
+def _bucket(n: int, minimum: int = 8) -> int:
+    """Next power-of-two bucket ≥ n (≥ minimum), to bound compile count."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def pad_feature_batch(
+    rows: list[tuple[dict[int, float], np.ndarray, float]],
+    row_bucket: int = 0,
+    token_bucket: int = 0,
+) -> FeatureBatch:
+    """Assemble per-tweet sparse features into one padded FeatureBatch.
+
+    ``rows`` holds (text_counts: {hashed_idx: count}, numeric[4], label) per
+    tweet, i.e. the output of ``Featurizer.featurize``. Padding rows carry
+    mask 0 and are excluded from every statistic and gradient on device.
+    """
+    n = len(rows)
+    max_tok = max((len(r[0]) for r in rows), default=1)
+    b = row_bucket if row_bucket >= n and row_bucket > 0 else _bucket(max(n, 1))
+    lt = token_bucket if token_bucket >= max_tok and token_bucket > 0 else _bucket(
+        max(max_tok, 1)
+    )
+
+    token_idx = np.zeros((b, lt), dtype=np.int32)
+    token_val = np.zeros((b, lt), dtype=np.float32)
+    numeric = np.zeros((b, NUM_NUMBER_FEATURES), dtype=np.float32)
+    label = np.zeros((b,), dtype=np.float32)
+    mask = np.zeros((b,), dtype=np.float32)
+
+    for i, (counts, nums, lab) in enumerate(rows):
+        for j, (idx, val) in enumerate(counts.items()):
+            token_idx[i, j] = idx
+            token_val[i, j] = val
+        numeric[i] = nums
+        label[i] = lab
+        mask[i] = 1.0
+    return FeatureBatch(token_idx, token_val, numeric, label, mask)

@@ -1,0 +1,60 @@
+"""Hashing-trick text features, bit-compatible with MLlib's HashingTF.
+
+The reference featurizes tweets with ``new HashingTF(numTextFeatures)`` over
+character bigrams (MllibHelper.scala:18,42-56). MLlib 1.6's HashingTF maps a
+term to ``nonNegativeMod(term.##, numFeatures)`` where ``.##`` on a String is
+Java ``String.hashCode`` — a 31-ary polynomial over UTF-16 code units in
+32-bit signed arithmetic. Reproducing that hash exactly makes our feature
+vectors (and therefore RMSE curves) directly comparable with the reference.
+
+A C++ fast path for whole-tweet hashing lives in ``native/`` (optional); this
+module is the always-available pure-Python implementation and the semantic
+ground truth.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Iterable
+
+
+def java_string_hashcode(s: str) -> int:
+    """Java ``String.hashCode``: h = 31*h + c over UTF-16 code units,
+    wrapping in 32-bit signed arithmetic.
+
+    Characters outside the BMP (emoji — common in tweets) contribute their
+    two surrogate code units, exactly as on the JVM.
+    """
+    h = 0
+    for unit_lo, unit_hi in zip(
+        *[iter(s.encode("utf-16-le"))] * 2
+    ):  # little-endian 16-bit code units
+        cu = unit_lo | (unit_hi << 8)
+        h = (31 * h + cu) & 0xFFFFFFFF
+    if h >= 0x80000000:
+        h -= 0x100000000
+    return h
+
+
+def non_negative_mod(x: int, mod: int) -> int:
+    """MLlib Utils.nonNegativeMod; equals Python's ``%`` for positive mod."""
+    return x % mod
+
+
+def char_bigrams(text: str) -> list[str]:
+    """Scala ``text.sliding(2)``: consecutive 2-char windows; a string shorter
+    than 2 yields itself as the single (short) window, empty yields nothing."""
+    if len(text) == 0:
+        return []
+    if len(text) < 2:
+        return [text]
+    return [text[i : i + 2] for i in range(len(text) - 1)]
+
+
+def hashing_tf_counts(terms: Iterable[str], num_features: int) -> dict[int, float]:
+    """HashingTF.transform: term-frequency counts keyed by hashed index.
+    Distinct terms colliding on an index accumulate, like MLlib."""
+    counts: Counter[int] = Counter()
+    for term in terms:
+        counts[non_negative_mod(java_string_hashcode(term), num_features)] += 1
+    return {idx: float(c) for idx, c in counts.items()}

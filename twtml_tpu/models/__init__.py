@@ -1,0 +1,11 @@
+from .base import StepOutput
+from .linear import StreamingLinearRegressionWithSGD
+from .logistic import StreamingLogisticRegressionWithSGD
+from .kmeans import StreamingKMeans
+
+__all__ = [
+    "StepOutput",
+    "StreamingLinearRegressionWithSGD",
+    "StreamingLogisticRegressionWithSGD",
+    "StreamingKMeans",
+]

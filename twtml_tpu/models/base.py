@@ -1,0 +1,29 @@
+"""Shared learner contracts.
+
+A streaming learner holds device-resident state (weights in HBM — unlike the
+reference, which re-serializes driver weights into every batch closure,
+LinearRegression.scala:57) and exposes one fused, jit-compiled
+predict-then-train step per micro-batch: the incoming batch is scored with the
+*pre-update* weights (progressive validation, the reference's explicit
+ordering at LinearRegression.scala:85-86), per-batch statistics are reduced
+on device, and the SGD iterations run inside the same XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class StepOutput(NamedTuple):
+    """Device results of one micro-batch step. ``predictions`` keeps the full
+    padded [B] vector (with ``mask`` deciding validity) so telemetry can ship
+    the real-vs-pred series like the reference does to Lightning
+    (SessionStats.scala:31-33); the scalars are the dashboard stats."""
+
+    predictions: jnp.ndarray  # [B] rounded predictions (pre-update weights)
+    count: jnp.ndarray  # scalar — valid rows in this batch (global if psum)
+    mse: jnp.ndarray  # scalar — mean((y - round(ŷ))²) over valid rows
+    real_stdev: jnp.ndarray  # scalar — population stdev of labels
+    pred_stdev: jnp.ndarray  # scalar — population stdev of rounded preds

@@ -1,0 +1,23 @@
+"""Streaming linear regression with SGD — the flagship model.
+
+TPU-native equivalent of MLlib's ``StreamingLinearRegressionWithSGD`` as the
+reference configures it (LinearRegression.scala:28-32: numIterations,
+stepSize, miniBatchFraction, zero initial weights over numFeatures dims).
+State is a single weight vector resident in device HBM; each micro-batch runs
+one fused jit program that scores the batch with pre-update weights
+(progressive validation) and then applies the full inner SGD loop
+(models/sgd.py). Least-squares gradient (MLlib LeastSquaresGradient) and
+HALF_UP-rounded predictions for the reported metrics
+(LinearRegression.scala:57, Utils.scala:4-6).
+"""
+
+from __future__ import annotations
+
+from .sgd import StreamingSGDModel
+
+
+class StreamingLinearRegressionWithSGD(StreamingSGDModel):
+    residual_fn = None  # least-squares: residual = w·x − y
+    prediction_fn = None  # identity link
+    round_predictions = True
+    default_step_size = 0.005  # reference.conf:4

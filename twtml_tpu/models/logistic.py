@@ -1,0 +1,30 @@
+"""Streaming logistic regression with SGD (BASELINE config #3).
+
+Equivalent of MLlib's ``StreamingLogisticRegressionWithSGD``: the same
+mini-batch SGD core as the linear model with the logistic gradient
+(multiplier σ(w·x) − y, MLlib LogisticGradient) and thresholded class
+predictions (σ(w·x) > 0.5 → 1.0, MLlib's default 0.5 threshold). The
+reference repo never shipped this model; it's part of the measured baseline
+configs (BASELINE.md #3: binary sentiment on the same stream).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .sgd import StreamingSGDModel
+
+
+def _logistic_residual(raw, label):
+    return jax.nn.sigmoid(raw) - label
+
+
+def _threshold_prediction(raw):
+    return (jax.nn.sigmoid(raw) > 0.5).astype(raw.dtype)
+
+
+class StreamingLogisticRegressionWithSGD(StreamingSGDModel):
+    residual_fn = staticmethod(_logistic_residual)
+    prediction_fn = staticmethod(_threshold_prediction)
+    round_predictions = False  # already a hard 0/1 class
+    default_step_size = 0.1  # MLlib StreamingLogisticRegressionWithSGD default

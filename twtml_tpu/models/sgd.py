@@ -1,0 +1,244 @@
+"""Fused streaming-SGD step builder — the compute core of the framework.
+
+This is the TPU re-expression of MLlib's ``GradientDescent.runMiniBatchSGD``
+driven by ``StreamingLinearRegressionWithSGD.trainOn`` (the reference's hot
+loop, SURVEY.md §3.3): per micro-batch, ``numIterations`` rounds of
+  sample(miniBatchFraction) → gradient → reduce → w ← w − stepSize/√i · ∇
+with the treeAggregate reduction replaced by an in-program ``psum`` over the
+``data`` mesh axis when running sharded, and the whole loop compiled as one
+XLA program (``lax.fori_loop``) so weights never leave HBM.
+
+MLlib semantics preserved:
+- per-iteration learning rate stepSize/√i, 1-indexed (SimpleUpdater);
+- L2: w scaled by (1 − η·λ) before the gradient step (SquaredL2Updater) when
+  l2_reg > 0 (the reference runs regParam 0; BASELINE config #4 adds L2);
+- Bernoulli mini-batch sampling per iteration, seeded by iteration number
+  (MLlib uses seed 42+i) — deterministic replay;
+- convergence tolerance on successive weight vectors:
+  ‖w_{i} − w_{i−1}‖₂ < tol · max(‖w_i‖₂, 1), early-stop;
+- an iteration that samples zero points leaves weights unchanged;
+- predictions for the batch are computed with pre-update weights
+  (predict-then-train, LinearRegression.scala:85-86).
+
+Two feature regimes (see ops/sparse.py): dense [B,F]×[F] MXU matmuls for
+small models, gather/scatter for 2^18-dim hashed features.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..features.batch import NUM_NUMBER_FEATURES, FeatureBatch
+from ..ops.sparse import densify_text, sparse_grad_text, sparse_predict
+from ..ops.stats import batch_stats
+from ..utils.rounding import jnp_round_half_up
+from .base import StepOutput
+
+# Above this text-feature count the dense [B, F] design matrix stops paying
+# for itself and the gather/scatter path wins (2^18 dims ≈ 1 GB dense at B=1k).
+DENSE_TEXT_FEATURE_LIMIT = 8192
+
+MLLIB_SAMPLING_SEED = 42  # GradientDescent samples with seed 42+i
+
+
+def make_sgd_train_step(
+    *,
+    num_text_features: int,
+    num_iterations: int,
+    step_size: float,
+    mini_batch_fraction: float = 1.0,
+    l2_reg: float = 0.0,
+    convergence_tol: float = 0.001,
+    residual_fn: Callable | None = None,
+    prediction_fn: Callable | None = None,
+    axis_name: str | None = None,
+    use_sparse: bool | None = None,
+    round_predictions: bool = True,
+):
+    """Build the fused (weights, batch) → (new_weights, StepOutput) step.
+
+    ``residual_fn(raw, label)`` is the per-example gradient multiplier
+    (identity diff for least-squares; σ(raw) − y for logistic), and
+    ``prediction_fn(raw)`` maps the raw margin to the reported prediction.
+    The returned function is pure and jit/shard_map-composable; wrap with
+    ``jax.jit(..., donate_argnums=0)`` to keep weights HBM-resident.
+    """
+    f_text = num_text_features
+    sparse = f_text > DENSE_TEXT_FEATURE_LIMIT if use_sparse is None else use_sparse
+    residual_fn = residual_fn or (lambda raw, label: raw - label)
+    prediction_fn = prediction_fn or (lambda raw: raw)
+
+    def _predict_raw(weights, batch: FeatureBatch, x_dense):
+        if sparse:
+            dtype = weights.dtype
+            return sparse_predict(
+                weights[:f_text],
+                weights[f_text:],
+                batch.token_idx,
+                batch.token_val.astype(dtype),
+                batch.numeric.astype(dtype),
+            )
+        return x_dense @ weights
+
+    def _grad_sum(batch: FeatureBatch, x_dense, residual):
+        if sparse:
+            dtype = residual.dtype
+            g_text = sparse_grad_text(
+                batch.token_idx, batch.token_val.astype(dtype), residual, f_text
+            )
+            g_num = residual @ batch.numeric.astype(dtype)
+            return jnp.concatenate([g_text, g_num])
+        return x_dense.T @ residual
+
+    def train_step(weights, batch: FeatureBatch):
+        dtype = weights.dtype
+        mask = batch.mask.astype(dtype)
+        labels = batch.label.astype(dtype)
+        x_dense = None
+        if not sparse:
+            x_dense = jnp.concatenate(
+                [
+                    densify_text(batch.token_idx, batch.token_val.astype(dtype), f_text),
+                    batch.numeric.astype(dtype),
+                ],
+                axis=1,
+            )
+
+        # ---- predict + stats with pre-update weights --------------------
+        raw = _predict_raw(weights, batch, x_dense)
+        preds = prediction_fn(raw)
+        if round_predictions:
+            preds = jnp_round_half_up(preds)
+        stats = batch_stats(labels, preds, mask, axis_name)
+
+        # ---- numIterations of mini-batch SGD ----------------------------
+        base_key = jax.random.PRNGKey(MLLIB_SAMPLING_SEED)
+
+        def body(i, carry):
+            w, converged = carry
+            it = i + 1  # MLlib iterations are 1-indexed
+            if mini_batch_fraction < 1.0:
+                sel = mask * jax.random.bernoulli(
+                    jax.random.fold_in(base_key, it),
+                    mini_batch_fraction,
+                    mask.shape,
+                ).astype(dtype)
+            else:
+                sel = mask
+            residual = residual_fn(_predict_raw(w, batch, x_dense), labels) * sel
+            grad_sum = _grad_sum(batch, x_dense, residual)
+            count = jnp.sum(sel)
+            if axis_name:
+                grad_sum = lax.psum(grad_sum, axis_name)
+                count = lax.psum(count, axis_name)
+            grad = grad_sum / jnp.maximum(count, 1.0)
+            eta = step_size / jnp.sqrt(jnp.asarray(it, dtype))
+            w_new = w * (1.0 - eta * l2_reg) - eta * grad
+            # zero sampled points → no update (MLlib warns and skips)
+            w_new = jnp.where(count > 0, w_new, w)
+            if convergence_tol > 0:
+                delta = jnp.linalg.norm(w_new - w)
+                # a zero-sample iteration is a skip, not convergence
+                conv_now = (count > 0) & (
+                    delta
+                    < convergence_tol * jnp.maximum(jnp.linalg.norm(w_new), 1.0)
+                )
+            else:
+                conv_now = jnp.array(False)
+            w_out = jnp.where(converged, w, w_new)
+            return w_out, converged | conv_now
+
+        w_final, _ = lax.fori_loop(
+            0, num_iterations, body, (weights, jnp.array(False))
+        )
+        return w_final, StepOutput(predictions=preds, **stats)
+
+    return train_step
+
+
+def zero_weights(num_text_features: int, dtype=jnp.float32):
+    """MLlib initial weights: zeros(numFeatures) (LinearRegression.scala:32)."""
+    return jnp.zeros((num_text_features + NUM_NUMBER_FEATURES,), dtype=dtype)
+
+
+class StreamingSGDModel:
+    """Shared surface of the streaming SGD learners (linear/logistic):
+    device-resident weight state, fused jit step with donated weights, conf
+    plumbing, and DStream-style ``train_on`` registration. Subclasses set the
+    three gradient knobs (``residual_fn``, ``prediction_fn``,
+    ``round_predictions``) and a default step size."""
+
+    residual_fn = None  # least-squares when None
+    prediction_fn = None  # identity when None
+    round_predictions = True
+    default_step_size = 0.1
+
+    def __init__(
+        self,
+        num_text_features: int = 1000,
+        num_iterations: int = 50,
+        step_size: float | None = None,
+        mini_batch_fraction: float = 1.0,
+        l2_reg: float = 0.0,
+        convergence_tol: float = 0.001,
+        dtype=jnp.float32,
+        use_sparse: bool | None = None,
+    ) -> None:
+        self.num_text_features = num_text_features
+        self.dtype = dtype
+        self._weights = zero_weights(num_text_features, dtype)
+        step = make_sgd_train_step(
+            num_text_features=num_text_features,
+            num_iterations=num_iterations,
+            step_size=self.default_step_size if step_size is None else step_size,
+            mini_batch_fraction=mini_batch_fraction,
+            l2_reg=l2_reg,
+            convergence_tol=convergence_tol,
+            residual_fn=type(self).residual_fn,
+            prediction_fn=type(self).prediction_fn,
+            round_predictions=self.round_predictions,
+            use_sparse=use_sparse,
+        )
+        # donate weights: the update happens in-place in HBM
+        self._step = jax.jit(step, donate_argnums=0)
+
+    @classmethod
+    def from_conf(cls, conf, **overrides):
+        kwargs = dict(
+            num_text_features=conf.numTextFeatures,
+            num_iterations=conf.numIterations,
+            step_size=conf.stepSize,
+            mini_batch_fraction=conf.miniBatchFraction,
+            l2_reg=conf.l2Reg,
+            convergence_tol=conf.convergenceTol,
+            dtype=jnp.dtype(conf.dtype),
+        )
+        kwargs.update(overrides)
+        return cls(**kwargs)
+
+    def set_initial_weights(self, weights) -> "StreamingSGDModel":
+        self._weights = jnp.asarray(weights, dtype=self.dtype)
+        return self
+
+    @property
+    def latest_weights(self):
+        import numpy as np
+
+        return np.asarray(self._weights)
+
+    def step(self, batch: FeatureBatch) -> StepOutput:
+        """Fused predict-then-train on one micro-batch; advances the model."""
+        self._weights, out = self._step(self._weights, batch)
+        return out
+
+    def train_on(self, stream) -> None:
+        """Register the fused step as a stream output (DStream.trainOn analog;
+        the reference registers stats first, then training —
+        LinearRegression.scala:53,86 — the fused step preserves that order
+        internally)."""
+        stream.foreach_batch(lambda batch, _time: self.step(batch))

@@ -1,0 +1,11 @@
+from .stats import masked_mean, masked_stdev, batch_stats
+from .sparse import densify_text, sparse_predict, sparse_grad_text
+
+__all__ = [
+    "masked_mean",
+    "masked_stdev",
+    "batch_stats",
+    "densify_text",
+    "sparse_predict",
+    "sparse_grad_text",
+]

@@ -1,0 +1,11 @@
+from .context import StreamingContext, FeatureStream
+from .sources import ReplayFileSource, SyntheticSource, QueueSource, Source
+
+__all__ = [
+    "StreamingContext",
+    "FeatureStream",
+    "ReplayFileSource",
+    "SyntheticSource",
+    "QueueSource",
+    "Source",
+]

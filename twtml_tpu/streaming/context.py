@@ -1,0 +1,167 @@
+"""Micro-batch streaming runtime — the DStream/StreamingContext equivalent.
+
+The reference slices a live stream into RDDs every ``seconds`` and runs two
+registered outputs per batch: the stats ``foreachRDD`` and ``model.trainOn``
+(LinearRegression.scala:40-47,53,86). Here a ``StreamingContext`` owns one
+source feeding a thread-safe queue; a scheduler thread wakes every
+``batch_interval`` seconds, drains the queue, filters + featurizes + pads the
+tweets into one fixed-shape ``FeatureBatch``, and invokes every registered
+output in registration order (so stats-before-train ordering is preserved
+when callers register them separately; the fused model step keeps it
+internally regardless).
+
+Differences by design:
+- featurization happens once per batch on the host (numpy), not as per-element
+  closures shipped to executors — the device program consumes one padded batch;
+- ``run_to_completion`` offers a deterministic clock-free mode (replay/bench):
+  process fixed-size batches back-to-back until the source is exhausted,
+  which wall-clock DStreams cannot do;
+- batch row/token counts are padded to power-of-two buckets (features/batch.py)
+  so XLA compiles a handful of programs, not one per batch shape.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable
+
+from ..features.batch import FeatureBatch
+from ..features.featurizer import Featurizer, Status
+from ..utils import get_logger
+from .sources import Source
+
+log = get_logger("streaming.context")
+
+BatchFn = Callable[[FeatureBatch, float], None]
+
+
+class FeatureStream:
+    """A stream of FeatureBatches with registered outputs (DStream analog)."""
+
+    def __init__(self, featurizer: Featurizer, row_bucket: int = 0, token_bucket: int = 0):
+        self.featurizer = featurizer
+        self.row_bucket = row_bucket
+        self.token_bucket = token_bucket
+        self._outputs: list[BatchFn] = []
+
+    def foreach_batch(self, fn: BatchFn) -> "FeatureStream":
+        """Register an output, fired per micro-batch in registration order
+        (reference: foreachRDD at LinearRegression.scala:53, trainOn at :86)."""
+        self._outputs.append(fn)
+        return self
+
+    def _process(self, statuses: list[Status], batch_time: float) -> FeatureBatch:
+        batch = self.featurizer.featurize_batch(
+            statuses, row_bucket=self.row_bucket, token_bucket=self.token_bucket
+        )
+        for fn in self._outputs:
+            fn(batch, batch_time)
+        return batch
+
+
+class StreamingContext:
+    def __init__(self, batch_interval: float = 5.0):
+        self.batch_interval = batch_interval
+        self._queue: "queue.Queue[Status]" = queue.Queue()
+        self._source: Source | None = None
+        self._stream: FeatureStream | None = None
+        self._scheduler: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._terminated = threading.Event()
+        self.batches_processed = 0
+
+    def source_stream(
+        self,
+        source: Source,
+        featurizer: Featurizer,
+        row_bucket: int = 0,
+        token_bucket: int = 0,
+    ) -> FeatureStream:
+        """Attach the (single) source and build its feature stream —
+        equivalent of TwitterUtils.createStream().filter().map().cache()
+        (LinearRegression.scala:44-47)."""
+        if self._source is not None:
+            raise ValueError("StreamingContext supports one source stream")
+        self._source = source
+        self._stream = FeatureStream(featurizer, row_bucket, token_bucket)
+        return self._stream
+
+    def _drain(self) -> list[Status]:
+        out: list[Status] = []
+        while True:
+            try:
+                out.append(self._queue.get_nowait())
+            except queue.Empty:
+                return out
+
+    def _run_batch(self, statuses: list[Status], batch_time: float) -> None:
+        try:
+            self._stream._process(statuses, batch_time)
+            self.batches_processed += 1
+        except Exception:
+            log.exception("batch at t=%.3f failed", batch_time)
+
+    def _scheduler_loop(self) -> None:
+        next_tick = time.monotonic() + self.batch_interval
+        while not self._stop.is_set():
+            delay = next_tick - time.monotonic()
+            if delay > 0 and self._stop.wait(delay):
+                break
+            next_tick += self.batch_interval
+            self._run_batch(self._drain(), time.time())
+            if self._source.exhausted and self._queue.empty():
+                break
+        self._terminated.set()
+
+    # -- lifecycle (ssc.start/awaitTermination, LinearRegression.scala:89-91) --
+    def start(self) -> None:
+        if self._stream is None:
+            raise ValueError("no stream registered")
+        self._stop.clear()
+        self._terminated.clear()
+        self._source.start(self._queue.put)
+        self._scheduler = threading.Thread(
+            target=self._scheduler_loop, name="twtml-batch-scheduler", daemon=True
+        )
+        self._scheduler.start()
+
+    def await_termination(self, timeout: float | None = None) -> bool:
+        return self._terminated.wait(timeout)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._source is not None:
+            self._source.stop()
+        if self._scheduler is not None:
+            self._scheduler.join(timeout=10)
+        self._terminated.set()
+
+    # -- deterministic replay mode (no wall clock) ---------------------------
+    def run_to_completion(self, max_batch_size: int = 1024) -> int:
+        """Drive the source synchronously: fill batches of up to
+        ``max_batch_size`` tweets and process back-to-back. Returns number of
+        batches run. Used by benchmarks and parity tests where the 5s cadence
+        would only add idle time."""
+        if self._stream is None:
+            raise ValueError("no stream registered")
+        self._source.start(self._queue.put)
+        n0 = self.batches_processed
+        pending: list[Status] = []
+        while True:
+            try:
+                pending.append(self._queue.get(timeout=0.05))
+                if len(pending) >= max_batch_size:
+                    self._run_batch(pending, time.time())
+                    pending = []
+            except queue.Empty:
+                if self._source.exhausted:
+                    # re-drain: the source may have emitted between our
+                    # timeout and the exhausted flag being set
+                    pending.extend(self._drain())
+                    break
+        if pending:
+            self._run_batch(pending, time.time())
+        self._terminated.set()
+        return self.batches_processed - n0

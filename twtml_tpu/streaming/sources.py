@@ -1,0 +1,196 @@
+"""Stream sources — the receiver layer.
+
+The reference's only receiver is ``TwitterUtils.createStream`` (a Twitter4j
+long-lived socket pinned to one executor, LinearRegression.scala:44;
+SURVEY.md §2.4.4 "receiver parallelism = 1"). Here a source is a small
+supervised producer thread pushing parsed ``Status`` objects into the
+micro-batcher's queue:
+
+- ``ReplayFileSource`` — deterministic replay of a tweets .jsonl fixture
+  (the BASELINE configs' replayed-tweet source), optionally rate-paced;
+- ``SyntheticSource`` — parameterized synthetic tweet generator with a known
+  ground-truth linear relationship (for parity tests and benchmarks);
+- ``QueueSource`` — push-from-test source;
+- the live ``TwitterSource`` lives in twitter.py (gated on credentials).
+
+Supervision: a crashed producer thread is restarted with exponential backoff
+(``max_restarts``), the upgrade over Spark's receiver defaults the survey
+calls for (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from typing import Callable, Iterator
+
+from ..features.featurizer import Status
+from ..utils import get_logger
+
+log = get_logger("streaming.sources")
+
+
+class Source:
+    """Base: override ``produce`` (a generator of Status) — the harness turns
+    it into a supervised thread feeding ``emit``."""
+
+    name = "source"
+
+    def __init__(self, max_restarts: int = 3, restart_backoff: float = 1.0):
+        self._emit: Callable[[Status], None] | None = None
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._exhausted = threading.Event()
+        self.max_restarts = max_restarts
+        self.restart_backoff = restart_backoff
+
+    def produce(self) -> Iterator[Status]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def start(self, emit: Callable[[Status], None]) -> None:
+        self._emit = emit
+        self._stop.clear()
+        self._exhausted.clear()
+        self._thread = threading.Thread(
+            target=self._run_supervised, name=f"twtml-source-{self.name}", daemon=True
+        )
+        self._thread.start()
+
+    def _run_supervised(self) -> None:
+        restarts = 0
+        while not self._stop.is_set():
+            try:
+                for status in self.produce():
+                    if self._stop.is_set():
+                        return
+                    self._emit(status)
+                self._exhausted.set()
+                return  # clean end of stream
+            except Exception:
+                restarts += 1
+                if restarts > self.max_restarts:
+                    log.exception("source %s died permanently", self.name)
+                    self._exhausted.set()
+                    return
+                backoff = self.restart_backoff * (2 ** (restarts - 1))
+                log.exception(
+                    "source %s crashed; restart %d/%d in %.1fs",
+                    self.name, restarts, self.max_restarts, backoff,
+                )
+                if self._stop.wait(backoff):
+                    return
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    @property
+    def exhausted(self) -> bool:
+        return self._exhausted.is_set()
+
+
+class ReplayFileSource(Source):
+    """Replay a .jsonl file of tweet objects. ``speed`` = 0 replays as fast
+    as possible; otherwise tweets are paced at ``speed`` × realtime using the
+    inter-tweet gaps in their timestamps (missing timestamps → 10ms gap)."""
+
+    name = "replay"
+
+    def __init__(self, path: str, speed: float = 0.0, loop: bool = False, **kw):
+        super().__init__(**kw)
+        self.path = path
+        self.speed = speed
+        self.loop = loop
+
+    def produce(self) -> Iterator[Status]:
+        while True:
+            prev_ms: int | None = None
+            with open(self.path, encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    status = Status.from_json(json.loads(line))
+                    if self.speed > 0:
+                        gap_ms = 10.0
+                        if prev_ms and status.created_at_ms > prev_ms:
+                            gap_ms = status.created_at_ms - prev_ms
+                        prev_ms = status.created_at_ms or prev_ms
+                        if self._stop.wait(gap_ms / 1000.0 / self.speed):
+                            return
+                    yield status
+            if not self.loop:
+                return
+
+
+class SyntheticSource(Source):
+    """Generate tweets whose retweet counts follow a known linear function of
+    the features — gives analytically checkable RMSE curves (SURVEY.md §7
+    stage 3). ``rate`` = tweets/sec (0 = unpaced), ``total`` = stop after n."""
+
+    name = "synthetic"
+
+    _WORDS = (
+        "tpu stream learn fast jax pallas shard mesh grad psum tweet viral "
+        "scale batch online model predict train news data"
+    ).split()
+
+    def __init__(self, total: int = 0, rate: float = 0.0, seed: int = 0, **kw):
+        super().__init__(**kw)
+        self.total = total
+        self.rate = rate
+        self.seed = seed
+
+    def produce(self) -> Iterator[Status]:
+        import numpy as np
+
+        rng = np.random.default_rng(self.seed)
+        count = 0
+        while self.total <= 0 or count < self.total:
+            n_words = int(rng.integers(3, 9))
+            words = rng.choice(self._WORDS, size=n_words)
+            text = " ".join(words)
+            followers = int(rng.integers(100, 2_000_000))
+            # ground truth: label correlates with followers + text length
+            label = int(
+                np.clip(100 + followers * 4e-4 + len(text) * 2 + rng.normal(0, 20),
+                        100, 1000)
+            )
+            original = Status(
+                text=text,
+                retweet_count=label,
+                followers_count=followers,
+                favourites_count=int(rng.integers(0, 50_000)),
+                friends_count=int(rng.integers(0, 10_000)),
+                created_at_ms=int(time.time() * 1000) - int(rng.integers(0, 86_400_000)),
+            )
+            yield Status(text="RT " + text, retweeted_status=original)
+            count += 1
+            if self.rate > 0 and self._stop.wait(1.0 / self.rate):
+                return
+
+
+class QueueSource(Source):
+    """Test source: push Status objects from the test thread."""
+
+    name = "queue"
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._q: "queue.Queue[Status | None]" = queue.Queue()
+
+    def push(self, status: Status) -> None:
+        self._q.put(status)
+
+    def close(self) -> None:
+        self._q.put(None)
+
+    def produce(self) -> Iterator[Status]:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            yield item

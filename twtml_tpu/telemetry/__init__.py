@@ -1,0 +1,5 @@
+from .api_types import Config, Stats, decode, encode
+from .web_client import WebClient
+from .session_stats import SessionStats
+
+__all__ = ["Config", "Stats", "decode", "encode", "WebClient", "SessionStats"]

@@ -1,0 +1,57 @@
+"""Wire schema for the telemetry API — byte-compatible with the reference.
+
+The reference serializes two case classes with json4s ``ShortTypeHints``,
+which adds a ``jsonClass`` discriminator field (spark/.../web/ApiTypes.scala:5-17,
+WebClient.scala:11; consumed by the browser at js/index.js:9-16 and the cache
+at ApiCache.scala:19-20,41-48). The exact same JSON shape is kept so the
+reference's dashboards and ours are interchangeable:
+
+  {"jsonClass":"Config","id":"...","host":"...","viz":["..."]}
+  {"jsonClass":"Stats","count":0,"batch":0,"mse":0,"realStddev":0,"predStddev":0}
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+
+
+@dataclass
+class Config:
+    id: str = ""
+    host: str = ""
+    viz: list[str] = field(default_factory=list)
+
+    json_class = "Config"
+
+
+@dataclass
+class Stats:
+    count: int = 0
+    batch: int = 0
+    mse: int = 0
+    realStddev: int = 0
+    predStddev: int = 0
+
+    json_class = "Stats"
+
+
+TYPES = {"Config": Config, "Stats": Stats}
+
+
+def encode(obj: Config | Stats) -> str:
+    payload = {"jsonClass": obj.json_class}
+    payload.update(asdict(obj))
+    return json.dumps(payload)
+
+
+def decode(text: str) -> Config | Stats:
+    """Dispatch on the jsonClass hint (ApiCache.scala:41-48); raises on
+    unknown types like the reference logs-and-drops."""
+    payload = json.loads(text)
+    kind = payload.pop("jsonClass", None)
+    cls = TYPES.get(kind)
+    if cls is None:
+        raise ValueError(f"json not recognized: {text!r}")
+    fields = {k: payload[k] for k in cls.__dataclass_fields__ if k in payload}
+    return cls(**fields)

@@ -1,0 +1,79 @@
+"""Lightning visualization-server client (line-streaming subset).
+
+Replaces the vendored lightning-scala jar (spark/lib/lightning-scala_2.10-*.jar).
+Only the API surface the reference actually uses is implemented
+(SessionStats.scala:11,31-33,49-52 and KMeans.scala:86-87):
+
+- ``Lightning(host)`` with lazy session creation (``create_session``);
+- ``line_streaming(series, size=None, color=None)`` → new ``Visualization``
+  (type ``line-streaming``) seeded with the given series;
+- ``line_streaming(series, viz=viz)`` → append data to the live chart.
+
+Endpoints follow the public Lightning REST protocol: ``POST /sessions/``,
+``POST /sessions/{id}/visualizations/``, ``POST /visualizations/{id}/data/``.
+All calls are plain stdlib HTTP; callers keep the reference's best-effort
+``Try`` semantics (telemetry failures never stop training).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Visualization:
+    id: str
+    session: str
+    host: str
+
+
+@dataclass
+class Lightning:
+    host: str = "http://localhost:3000"
+    session: str = ""
+    auth: tuple[str, str] | None = None
+    timeout: float = 2.0
+
+    def _post(self, path: str, payload: dict) -> dict:
+        req = urllib.request.Request(
+            self.host.rstrip("/") + path,
+            data=json.dumps(payload).encode("utf-8"),
+            headers={"content-type": "application/json", "accept": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            body = resp.read().decode("utf-8")
+        return json.loads(body) if body else {}
+
+    def create_session(self, name: str = "") -> str:
+        out = self._post("/sessions/", {"name": name} if name else {})
+        self.session = str(out.get("id", ""))
+        return self.session
+
+    def line_streaming(
+        self,
+        series,
+        size=None,
+        color=None,
+        viz: Visualization | None = None,
+    ) -> Visualization:
+        """Create (viz=None) or append to a streaming line chart — mirrors
+        lightning-scala's ``lineStreaming`` used at SessionStats.scala:31-33
+        (append) and :49-52 (create with size/color options)."""
+        data: dict = {"series": [list(map(float, s)) for s in series]}
+        if size is not None:
+            data["size"] = list(map(float, size))
+        if color is not None:
+            data["color"] = [list(map(float, c)) for c in color]
+        if viz is None:
+            if not self.session:
+                self.create_session()
+            out = self._post(
+                f"/sessions/{self.session}/visualizations/",
+                {"type": "line-streaming", "data": data},
+            )
+            return Visualization(id=str(out.get("id", "")), session=self.session, host=self.host)
+        self._post(f"/visualizations/{viz.id}/data/", {"data": data})
+        return viz

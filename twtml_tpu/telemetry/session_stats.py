@@ -1,0 +1,87 @@
+"""Per-session stats publishing (reference: SessionStats.scala:9-63).
+
+Opens a 4-series Lightning streaming line chart (real=blue, pred=yellow, with
+lighter "detail" shades, SessionStats.scala:15-20,49-52), registers the
+session with the twtml web server (``web.config``), and pushes per-batch
+stats to both. Every network call is best-effort (``Try`` in the reference,
+SessionStats.scala:29-33,60): the ML loop must survive telemetry outages.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils import get_logger, round_half_up
+from .lightning import Lightning, Visualization
+from .web_client import WebClient
+
+log = get_logger("telemetry.session")
+
+# SessionStats.scala:15-20
+REAL_COLOR_DET = [173.0, 216.0, 230.0]  # light blue
+REAL_COLOR = [30.0, 144.0, 255.0]  # blue
+PRED_COLOR_DET = [238.0, 232.0, 170.0]  # pale yellow
+PRED_COLOR = [255.0, 215.0, 0.0]  # gold
+
+
+class SessionStats:
+    def __init__(self, conf):
+        self.conf = conf
+        self.lgn = Lightning(host=conf.lightning)
+        self.web = WebClient(conf.twtweb)
+        self.viz: Visualization | None = None
+
+    def open(self) -> "SessionStats":
+        log.info("Initializing plot on lightning server: %s", self.conf.lightning)
+        try:
+            self.viz = self.lgn.line_streaming(
+                series=[[0.0]] * 4,
+                size=[1.0, 1.0, 2.0, 2.0],
+                color=[REAL_COLOR_DET, PRED_COLOR_DET, REAL_COLOR, PRED_COLOR],
+            )
+            log.info(
+                "lightning session: %s/sessions/%s — %s/visualizations/%s/pym",
+                self.conf.lightning, self.viz.session,
+                self.conf.lightning, self.viz.id,
+            )
+        except Exception as exc:
+            log.warning("lightning unavailable (%s); charts disabled", exc)
+
+        log.info("Initializing config on web server: %s", self.conf.twtweb)
+        try:
+            self.web.config(
+                self.viz.session if self.viz else "",
+                self.lgn.host,
+                [self.viz.id] if self.viz else [],
+            )
+        except Exception as exc:
+            log.warning("twtml-web unavailable (%s); dashboard disabled", exc)
+        return self
+
+    def update(
+        self,
+        count: int,
+        batch: int,
+        mse: float,
+        real_stdev: float,
+        pred_stdev: float,
+        real: np.ndarray,
+        pred: np.ndarray,
+    ) -> None:
+        """Push one batch of stats — same call shape as SessionStats.update
+        (SessionStats.scala:22-34); mse/stdevs arrive already HALF_UP-rounded
+        and are truncated to int for the dashboard like ``.toLong``."""
+        try:
+            self.web.stats(count, batch, int(mse), int(real_stdev), int(pred_stdev))
+        except Exception:
+            log.debug("web.stats failed", exc_info=True)
+        if self.viz is not None:
+            try:
+                real_stdev_arr = [real_stdev] * int(batch)
+                pred_stdev_arr = [pred_stdev] * int(batch)
+                self.lgn.line_streaming(
+                    series=[list(real), list(pred), real_stdev_arr, pred_stdev_arr],
+                    viz=self.viz,
+                )
+            except Exception:
+                log.debug("lightning append failed", exc_info=True)

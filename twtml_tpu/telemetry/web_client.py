@@ -1,0 +1,59 @@
+"""Minimal JSON/HTTP client for the twtml web API.
+
+Same surface as the reference's scalaj-http client
+(spark/.../web/WebClient.scala:9-56): POST Config/Stats to ``{server}/api``,
+GET them back from ``/api/config`` and ``/api/stats``. stdlib urllib — no
+external HTTP dependency; callers wrap calls best-effort like the reference
+wraps them in ``Try`` (SessionStats.scala:29-33,60).
+"""
+
+from __future__ import annotations
+
+import urllib.request
+
+from .api_types import Config, Stats, decode, encode
+
+DEFAULT_SERVER = "http://localhost:8888"  # WebClient.scala:13
+
+
+class WebClient:
+    def __init__(self, server: str = "", timeout: float = 2.0):
+        self.server = server or DEFAULT_SERVER
+        self.timeout = timeout
+
+    def _request(self, kind: str = "", data: bytes | None = None):
+        req = urllib.request.Request(
+            self.server + "/api" + kind,
+            data=data,
+            headers={"content-type": "application/json", "accept": "application/json"},
+            method="POST" if data is not None else "GET",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return resp.read().decode("utf-8")
+
+    def _post(self, obj: Config | Stats) -> None:
+        self._request(data=encode(obj).encode("utf-8"))
+
+    # -- writes (WebClient.scala:31-38) --------------------------------------
+    def config(self, id: str, host: str, viz: list[str]) -> None:
+        self._post(Config(id=id, host=host, viz=list(viz)))
+
+    def stats(
+        self, count: int, batch: int, mse: int, real_stddev: int, pred_stddev: int
+    ) -> None:
+        self._post(
+            Stats(
+                count=int(count),
+                batch=int(batch),
+                mse=int(mse),
+                realStddev=int(real_stddev),
+                predStddev=int(pred_stddev),
+            )
+        )
+
+    # -- reads (WebClient.scala:40-46) ---------------------------------------
+    def get_config(self) -> Config:
+        return decode(self._request("/config"))
+
+    def get_stats(self) -> Stats:
+        return decode(self._request("/stats"))
