@@ -1,0 +1,102 @@
+"""Headline benchmark: streaming tweets/sec ingested+trained.
+
+Measures the full pipeline (host featurization → padded batch → fused
+predict+stats+train device step) on the attached accelerator, against the
+BASELINE.md metric "tweets/sec ingested+trained". The reference publishes no
+numbers (BASELINE.json ``published: {}``), so the baseline is measured in the
+same process family: the identical pipeline forced onto the CPU backend in a
+subprocess (the moral equivalent of the reference's ``local[8]`` operating
+point on this host).
+
+Prints ONE JSON line:
+  {"metric": "tweets_per_sec_e2e", "value": N, "unit": "tweets/s",
+   "vs_baseline": N / cpu_tweets_per_sec}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+N_TWEETS = 16384
+BATCH = 2048
+WARMUP_BATCHES = 2
+
+
+def measure(n_tweets: int = N_TWEETS, batch_size: int = BATCH) -> dict:
+    import numpy as np  # noqa: F401
+
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.models import StreamingLinearRegressionWithSGD
+    from twtml_tpu.streaming.sources import SyntheticSource
+
+    statuses = list(SyntheticSource(total=n_tweets, seed=3).produce())
+    feat = Featurizer(now_ms=1785320000000)
+    model = StreamingLinearRegressionWithSGD()
+
+    # warmup/compile on the first buckets
+    warm = feat.featurize_batch(statuses[:batch_size], row_bucket=batch_size)
+    for _ in range(WARMUP_BATCHES):
+        model.step(warm)
+
+    t0 = time.perf_counter()
+    done = 0
+    last = None
+    while done < n_tweets:
+        chunk = statuses[done : done + batch_size]
+        batch = feat.featurize_batch(chunk, row_bucket=batch_size, pre_filtered=True)
+        last = model.step(batch)
+        done += len(chunk)
+    last.mse.block_until_ready()
+    dt = time.perf_counter() - t0
+    return {
+        "tweets_per_sec": n_tweets / dt,
+        "seconds": dt,
+        "final_mse": float(last.mse),
+    }
+
+
+def main() -> None:
+    if os.environ.get("TWTML_BENCH_CHILD") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        out = measure(n_tweets=4096)
+        print(json.dumps(out))
+        return
+
+    device_result = measure()
+
+    # CPU baseline in a subprocess (same pipeline, CPU backend)
+    cpu_rate = None
+    try:
+        env = dict(os.environ, TWTML_BENCH_CHILD="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        cpu_rate = json.loads(proc.stdout.strip().splitlines()[-1])["tweets_per_sec"]
+    except Exception:
+        cpu_rate = None
+
+    value = device_result["tweets_per_sec"]
+    print(
+        json.dumps(
+            {
+                "metric": "tweets_per_sec_e2e",
+                "value": round(value, 1),
+                "unit": "tweets/s",
+                "vs_baseline": round(value / cpu_rate, 2) if cpu_rate else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

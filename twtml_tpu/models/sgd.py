@@ -117,7 +117,14 @@ def make_sgd_train_step(
         stats = batch_stats(labels, preds, mask, axis_name)
 
         # ---- numIterations of mini-batch SGD ----------------------------
+        # Sampling keys: seed 42 like MLlib (GradientDescent's 42+i), with the
+        # data-shard index folded in under shard_map so shards draw
+        # independent masks. Sampled subsets therefore differ between mesh
+        # layouts (as they do between Spark partitionings) but are
+        # statistically equivalent; fraction=1.0 (the default) is exact.
         base_key = jax.random.PRNGKey(MLLIB_SAMPLING_SEED)
+        if axis_name and mini_batch_fraction < 1.0:
+            base_key = jax.random.fold_in(base_key, lax.axis_index(axis_name))
 
         def body(i, carry):
             w, converged = carry

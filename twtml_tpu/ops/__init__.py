@@ -1,5 +1,5 @@
 from .stats import masked_mean, masked_stdev, batch_stats
-from .sparse import densify_text, sparse_predict, sparse_grad_text
+from .sparse import densify_text, sparse_predict, sparse_grad_text, sparse_text_dot
 
 __all__ = [
     "masked_mean",
@@ -8,4 +8,5 @@ __all__ = [
     "densify_text",
     "sparse_predict",
     "sparse_grad_text",
+    "sparse_text_dot",
 ]

@@ -31,12 +31,19 @@ def densify_text(token_idx, token_val, num_text_features):
     return dense.at[rows, token_idx].add(token_val)
 
 
+def sparse_text_dot(w_text, token_idx, token_val):
+    """Σ_j w_text[idx_j]·val_j per row — the text half of the sparse dot.
+    Shared by the single-device sparse path and the feature-sharded path
+    (which calls it on slice-local indices with out-of-slice values zeroed,
+    then psums partial dots over the model axis)."""
+    gathered = jnp.take(w_text, token_idx, axis=0)  # [B, L]
+    return jnp.sum(gathered * token_val, axis=1)  # [B]
+
+
 def sparse_predict(w_text, w_num, token_idx, token_val, numeric):
     """ŷ = Σ_j w_text[idx_j]·val_j + numeric·w_num, no dense materialization.
     Equivalent to SparseVector dot (MLlib predict, LinearRegression.scala:57)."""
-    gathered = jnp.take(w_text, token_idx, axis=0)  # [B, L]
-    text_dot = jnp.sum(gathered * token_val, axis=1)  # [B]
-    return text_dot + numeric @ w_num
+    return sparse_text_dot(w_text, token_idx, token_val) + numeric @ w_num
 
 
 def sparse_grad_text(token_idx, token_val, residual, num_text_features):

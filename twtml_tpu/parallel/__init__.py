@@ -1,0 +1,12 @@
+from .mesh import make_mesh, default_mesh
+from .sharding import ParallelSGDModel, batch_pspecs, shard_batch
+from . import distributed
+
+__all__ = [
+    "make_mesh",
+    "default_mesh",
+    "ParallelSGDModel",
+    "batch_pspecs",
+    "shard_batch",
+    "distributed",
+]

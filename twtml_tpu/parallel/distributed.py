@@ -1,0 +1,83 @@
+"""Multi-host process-group formation.
+
+The reference's multi-node story is Spark cluster managers + Akka RPC
+(README.md:40-55 `--master spark://...`; SURVEY.md §2.4). The TPU-native
+equivalent is ``jax.distributed``: one Python controller per host joins a
+process group over DCN, after which ``jax.devices()`` spans the pod and the
+same Mesh/shard_map programs from sharding.py scale out — gradient psums ride
+ICI within a slice and DCN across slices, with zero application-code change.
+
+Stream intake is sharded by host (SURVEY.md §7 stage 5): each process runs
+its own source/featurizer and contributes its rows of the global batch via
+``host_local_batch_to_global``.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..features.batch import FeatureBatch
+from ..utils import get_logger
+
+log = get_logger("parallel.distributed")
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join the jax.distributed process group (idempotent). With no args,
+    reads the cluster env (TPU pod metadata / JAX_COORDINATOR_ADDRESS...).
+
+    Must run before anything initializes the XLA backend (jax.distributed's
+    own contract) — do NOT probe jax.process_count() first, that probe itself
+    initializes the backend and forecloses pod formation."""
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        log.info(
+            "joined process group: process %d/%d, %d global devices",
+            jax.process_index(), jax.process_count(), jax.device_count(),
+        )
+    except RuntimeError as exc:
+        # "already initialized" (re-entry) is fine; anything else on an
+        # explicitly-requested pod is a real failure the caller must see.
+        if "already" in str(exc).lower():
+            log.debug("jax.distributed already initialized")
+        elif coordinator_address is not None:
+            raise
+        else:
+            log.debug("jax.distributed not initialized (%s); single-process", exc)
+    except Exception as exc:  # auto-detection found no cluster env
+        if coordinator_address is not None:
+            raise
+        log.debug("jax.distributed not initialized (%s); single-process mode", exc)
+
+
+def host_local_batch_to_global(batch: FeatureBatch, mesh) -> FeatureBatch:
+    """Assemble each host's locally-featurized rows into one global
+    row-sharded batch (multi-host stream sharding). Single-process: no-op
+    beyond device placement."""
+    from jax.sharding import NamedSharding
+
+    from .sharding import batch_pspecs
+
+    if jax.process_count() == 1:
+        from .sharding import shard_batch
+
+        return shard_batch(batch, mesh)
+    specs = batch_pspecs(mesh.axis_names[0])
+    arrays = []
+    for host_arr, spec in zip(batch, specs):
+        sharding = NamedSharding(mesh, spec)
+        global_shape = (host_arr.shape[0] * jax.process_count(),) + host_arr.shape[1:]
+        arrays.append(
+            jax.make_array_from_process_local_data(sharding, np.asarray(host_arr),
+                                                   global_shape)
+        )
+    return FeatureBatch(*arrays)

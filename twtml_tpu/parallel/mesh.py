@@ -1,0 +1,56 @@
+"""Device-mesh construction.
+
+The reference's scale-out unit is a Spark executor fleet wired by Akka/Netty
+(SURVEY.md §2.4); ours is a ``jax.sharding.Mesh`` whose collectives ride ICI.
+Two axes cover this framework's needs:
+
+- ``data`` — micro-batch rows are sharded across it; the per-iteration
+  gradient reduce is a ``psum`` over it (the treeAggregate equivalent,
+  SURVEY.md §3.3);
+- ``model`` — optional: the hashed text-feature dimension is sharded across
+  it for the 2^18-dim featurizer (BASELINE config #4), the analog the survey
+  identifies for "long-context" scale (SURVEY.md §5.7: feature-dimension
+  sharding, not sequence parallelism).
+
+On a multi-host pod, ``jax.devices()`` spans all processes and the same mesh
+code yields DCN+ICI-aware placement (jax fills the mesh devices in process
+order); see distributed.py for process-group formation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    num_data: int | None = None,
+    num_model: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build a ('data',) or ('data','model') mesh over the given devices
+    (default: all). ``num_data=None`` uses every remaining device."""
+    devices = list(devices if devices is not None else jax.devices())
+    if num_data is None:
+        if len(devices) % num_model:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by num_model={num_model}"
+            )
+        num_data = len(devices) // num_model
+    need = num_data * num_model
+    if need > len(devices):
+        raise ValueError(f"mesh {num_data}x{num_model} needs {need} devices, "
+                         f"have {len(devices)}")
+    arr = np.array(devices[:need])
+    if num_model == 1:
+        return Mesh(arr.reshape(num_data), ("data",))
+    return Mesh(arr.reshape(num_data, num_model), ("data", "model"))
+
+
+def default_mesh(max_data: int | None = None) -> Mesh:
+    """All-devices data-parallel mesh; ``max_data`` caps the shard count
+    (the local[N] master hint, config.local_shards)."""
+    devices = jax.devices()
+    n = len(devices) if max_data is None else min(max_data, len(devices))
+    return make_mesh(num_data=n, devices=devices[:n])

@@ -1,0 +1,300 @@
+"""Sharded training — shard_map + psum over the device mesh.
+
+This is the TPU-native replacement for the reference's distributed runtime:
+MLlib's per-iteration ``treeAggregate`` of gradients to the driver
+(SURVEY.md §3.3) becomes an in-program ``psum`` over the mesh's ``data`` axis,
+and the driver→executor weight broadcast disappears entirely — weights are
+device-resident (replicated over ``data``, optionally sharded over ``model``).
+
+Two layouts:
+
+- **data-parallel** (model_axis=None): weights replicated, batch rows sharded;
+  reuses the single-device fused step (models/sgd.py) with ``axis_name`` so
+  gradient/stat reductions turn into ICI collectives. This is BASELINE
+  config #5 (4-way sharded stream + gradient allreduce).
+- **feature-sharded** (2D mesh): the hashed text-feature axis of the weights
+  is sharded over ``model`` for numTextFeatures=2^18 (BASELINE config #4):
+  each shard gathers/scatter-adds only tokens hashing into its slice, with a
+  ``psum`` over ``model`` reassembling predictions — a sharded-embedding
+  pattern, not a translation of any reference code (the reference caps at
+  1000 dims in one JVM).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..features.batch import NUM_NUMBER_FEATURES, FeatureBatch
+from ..models.base import StepOutput
+from ..models.sgd import MLLIB_SAMPLING_SEED, make_sgd_train_step
+from ..ops.sparse import sparse_grad_text, sparse_text_dot
+from ..ops.stats import batch_stats
+from ..utils.rounding import jnp_round_half_up
+
+
+def batch_pspecs(data_axis: str = "data") -> FeatureBatch:
+    """PartitionSpecs sharding a FeatureBatch's rows across ``data``."""
+    return FeatureBatch(
+        token_idx=P(data_axis, None),
+        token_val=P(data_axis, None),
+        numeric=P(data_axis, None),
+        label=P(data_axis),
+        mask=P(data_axis),
+    )
+
+
+def shard_batch(batch: FeatureBatch, mesh) -> FeatureBatch:
+    """Place a host batch onto the mesh with row sharding (explicit
+    device_put so repeated steps don't re-infer layouts)."""
+    specs = batch_pspecs(mesh.axis_names[0])
+    return FeatureBatch(*(
+        jax.device_put(arr, NamedSharding(mesh, spec))
+        for arr, spec in zip(batch, specs)
+    ))
+
+
+def _make_feature_sharded_step(
+    *,
+    f_text: int,
+    f_text_local: int,
+    num_iterations: int,
+    step_size: float,
+    mini_batch_fraction: float,
+    l2_reg: float,
+    convergence_tol: float,
+    residual_fn: Callable | None,
+    prediction_fn: Callable | None,
+    round_predictions: bool,
+    data_axis: str,
+    model_axis: str,
+):
+    """Per-shard body for the 2D (data × model) mesh. Weights arrive as a
+    {'text': [f_text_local], 'num': [4]} pytree; token indices are global and
+    each shard contributes only the tokens landing in its slice."""
+    residual_fn = residual_fn or (lambda raw, label: raw - label)
+    prediction_fn = prediction_fn or (lambda raw: raw)
+
+    def step(weights, batch: FeatureBatch):
+        w_text, w_num = weights["text"], weights["num"]
+        dtype = w_text.dtype
+        mask = batch.mask.astype(dtype)
+        labels = batch.label.astype(dtype)
+        token_val = batch.token_val.astype(dtype)
+        numeric = batch.numeric.astype(dtype)
+        lo = lax.axis_index(model_axis) * f_text_local
+        rel = batch.token_idx - lo
+        in_slice = ((rel >= 0) & (rel < f_text_local)).astype(dtype)
+        rel = jnp.clip(rel, 0, f_text_local - 1)
+        local_val = token_val * in_slice  # zero out tokens outside this slice
+
+        def predict(wt, wn):
+            part = sparse_text_dot(wt, rel, local_val)
+            return lax.psum(part, model_axis) + numeric @ wn
+
+        # ---- predict + stats with pre-update weights --------------------
+        preds = prediction_fn(predict(w_text, w_num))
+        if round_predictions:
+            preds = jnp_round_half_up(preds)
+        stats = batch_stats(labels, preds, mask, data_axis)
+
+        base_key = jax.random.PRNGKey(MLLIB_SAMPLING_SEED)
+        shard_key = jax.random.fold_in(base_key, lax.axis_index(data_axis))
+
+        def body(i, carry):
+            wt, wn, converged = carry
+            it = i + 1
+            if mini_batch_fraction < 1.0:
+                sel = mask * jax.random.bernoulli(
+                    jax.random.fold_in(shard_key, it),
+                    mini_batch_fraction,
+                    mask.shape,
+                ).astype(dtype)
+            else:
+                sel = mask
+            residual = residual_fn(predict(wt, wn), labels) * sel
+            g_text = lax.psum(
+                sparse_grad_text(rel, local_val, residual, f_text_local), data_axis
+            )
+            g_num = lax.psum(residual @ numeric, data_axis)
+            count = lax.psum(jnp.sum(sel), data_axis)
+            denom = jnp.maximum(count, 1.0)
+            eta = step_size / jnp.sqrt(jnp.asarray(it, dtype))
+            wt_new = wt * (1.0 - eta * l2_reg) - eta * g_text / denom
+            wn_new = wn * (1.0 - eta * l2_reg) - eta * g_num / denom
+            wt_new = jnp.where(count > 0, wt_new, wt)
+            wn_new = jnp.where(count > 0, wn_new, wn)
+            if convergence_tol > 0:
+                delta_sq = lax.psum(jnp.sum((wt_new - wt) ** 2), model_axis) + jnp.sum(
+                    (wn_new - wn) ** 2
+                )
+                norm_sq = lax.psum(jnp.sum(wt_new**2), model_axis) + jnp.sum(
+                    wn_new**2
+                )
+                conv_now = (count > 0) & (
+                    jnp.sqrt(delta_sq)
+                    < convergence_tol * jnp.maximum(jnp.sqrt(norm_sq), 1.0)
+                )
+            else:
+                conv_now = jnp.array(False)
+            wt_out = jnp.where(converged, wt, wt_new)
+            wn_out = jnp.where(converged, wn, wn_new)
+            return wt_out, wn_out, converged | conv_now
+
+        w_text, w_num, _ = lax.fori_loop(
+            0, num_iterations, body, (w_text, w_num, jnp.array(False))
+        )
+        return {"text": w_text, "num": w_num}, StepOutput(predictions=preds, **stats)
+
+    return step
+
+
+class ParallelSGDModel:
+    """Mesh-sharded streaming SGD learner with the same step surface as the
+    single-device models (models/sgd.py StreamingSGDModel)."""
+
+    def __init__(
+        self,
+        mesh,
+        num_text_features: int = 1000,
+        num_iterations: int = 50,
+        step_size: float = 0.005,
+        mini_batch_fraction: float = 1.0,
+        l2_reg: float = 0.0,
+        convergence_tol: float = 0.001,
+        dtype=jnp.float32,
+        residual_fn: Callable | None = None,
+        prediction_fn: Callable | None = None,
+        round_predictions: bool = True,
+        use_sparse: bool | None = None,
+    ) -> None:
+        self.mesh = mesh
+        self.num_text_features = num_text_features
+        self.dtype = dtype
+        axes = mesh.axis_names
+        self.data_axis = axes[0]
+        self.model_axis = axes[1] if len(axes) > 1 else None
+        self.num_data = mesh.shape[self.data_axis]
+        in_batch_specs = batch_pspecs(self.data_axis)
+        out_pred_spec = P(self.data_axis)
+        scalar = P()
+
+        if self.model_axis is None:
+            step = make_sgd_train_step(
+                num_text_features=num_text_features,
+                num_iterations=num_iterations,
+                step_size=step_size,
+                mini_batch_fraction=mini_batch_fraction,
+                l2_reg=l2_reg,
+                convergence_tol=convergence_tol,
+                residual_fn=residual_fn,
+                prediction_fn=prediction_fn,
+                round_predictions=round_predictions,
+                axis_name=self.data_axis,
+                use_sparse=use_sparse,
+            )
+            self._weights = jnp.zeros(
+                (num_text_features + NUM_NUMBER_FEATURES,), dtype
+            )
+            w_spec = P()
+        else:
+            num_model = mesh.shape[self.model_axis]
+            if num_text_features % num_model:
+                raise ValueError(
+                    f"numTextFeatures={num_text_features} not divisible by "
+                    f"model-axis size {num_model}"
+                )
+            step = _make_feature_sharded_step(
+                f_text=num_text_features,
+                f_text_local=num_text_features // num_model,
+                num_iterations=num_iterations,
+                step_size=step_size,
+                mini_batch_fraction=mini_batch_fraction,
+                l2_reg=l2_reg,
+                convergence_tol=convergence_tol,
+                residual_fn=residual_fn,
+                prediction_fn=prediction_fn,
+                round_predictions=round_predictions,
+                data_axis=self.data_axis,
+                model_axis=self.model_axis,
+            )
+            self._weights = {
+                "text": jax.device_put(
+                    jnp.zeros((num_text_features,), dtype),
+                    NamedSharding(mesh, P(self.model_axis)),
+                ),
+                "num": jnp.zeros((NUM_NUMBER_FEATURES,), dtype),
+            }
+            w_spec = {"text": P(self.model_axis), "num": P()}
+
+        sharded = jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(w_spec, in_batch_specs),
+            out_specs=(
+                w_spec,
+                StepOutput(
+                    predictions=out_pred_spec,
+                    count=scalar,
+                    mse=scalar,
+                    real_stdev=scalar,
+                    pred_stdev=scalar,
+                ),
+            ),
+        )
+        self._step = jax.jit(sharded, donate_argnums=0)
+
+    @classmethod
+    def from_conf(cls, conf, mesh, **overrides):
+        kwargs = dict(
+            num_text_features=conf.numTextFeatures,
+            num_iterations=conf.numIterations,
+            step_size=conf.stepSize,
+            mini_batch_fraction=conf.miniBatchFraction,
+            l2_reg=conf.l2Reg,
+            convergence_tol=conf.convergenceTol,
+            dtype=jnp.dtype(conf.dtype),
+        )
+        kwargs.update(overrides)
+        return cls(mesh, **kwargs)
+
+    @property
+    def latest_weights(self) -> np.ndarray:
+        if isinstance(self._weights, dict):
+            return np.concatenate(
+                [np.asarray(self._weights["text"]), np.asarray(self._weights["num"])]
+            )
+        return np.asarray(self._weights)
+
+    def set_initial_weights(self, weights) -> "ParallelSGDModel":
+        weights = np.asarray(weights, dtype=self.dtype)
+        if isinstance(self._weights, dict):
+            ft = self.num_text_features
+            self._weights = {
+                "text": jax.device_put(
+                    jnp.asarray(weights[:ft]),
+                    NamedSharding(self.mesh, P(self.model_axis)),
+                ),
+                "num": jnp.asarray(weights[ft:]),
+            }
+        else:
+            self._weights = jnp.asarray(weights)
+        return self
+
+    def step(self, batch: FeatureBatch) -> StepOutput:
+        b = batch.token_idx.shape[0]
+        if b % self.num_data:
+            raise ValueError(
+                f"batch rows {b} not divisible by data shards {self.num_data}; "
+                f"set --batchBucket to a multiple of the mesh's data axis"
+            )
+        self._weights, out = self._step(self._weights, batch)
+        return out
+
+    def train_on(self, stream) -> None:
+        stream.foreach_batch(lambda batch, _time: self.step(batch))

@@ -190,7 +190,12 @@ class QueueSource(Source):
 
     def produce(self) -> Iterator[Status]:
         while True:
-            item = self._q.get()
+            try:
+                item = self._q.get(timeout=0.1)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return  # interruptible without close()
+                continue
             if item is None:
                 return
             yield item
