@@ -1,0 +1,134 @@
+"""Web-server integration tests (reference: WebTestSuite.scala:10-42 — boot
+the real server in-process and round-trip Config/Stats over real HTTP), plus
+websocket broadcast/connect-push semantics the reference only exercised
+manually via test.html."""
+
+import asyncio
+import json
+
+import pytest
+
+from twtml_tpu.telemetry.api_types import Config, Stats
+from twtml_tpu.telemetry.web_client import WebClient
+from twtml_tpu.web.cache import ApiCache
+from twtml_tpu.web.server import Server
+
+HOST = "127.0.0.1"
+
+
+@pytest.fixture()
+def server(tmp_path):
+    cache = ApiCache(backup_file=str(tmp_path / "twtml-web.json"))
+    srv = Server(port=0, host=HOST, cache=cache)
+    srv.start_background()
+    # port 0 → discover the bound port
+    port = srv._runner.addresses[0][1]
+    yield srv, f"http://{HOST}:{port}", cache
+    srv.stop()
+
+
+def test_http_roundtrip_config_stats(server):
+    _, url, _ = server
+    client = WebClient(url)
+    client.config("100", "http://lightninghost", ["101", "102"])
+    client.stats(1000, 10, 2000, 15, 25)
+    assert client.get_config() == Config(id="100", host="http://lightninghost",
+                                         viz=["101", "102"])
+    assert client.get_stats() == Stats(count=1000, batch=10, mse=2000,
+                                       realStddev=15, predStddev=25)
+
+
+def test_defaults_before_any_post(server):
+    _, url, _ = server
+    client = WebClient(url)
+    assert client.get_config() == Config()
+    assert client.get_stats() == Stats()
+
+
+def test_unknown_json_is_dropped(server):
+    _, url, _ = server
+    import urllib.request
+
+    req = urllib.request.Request(
+        url + "/api", data=b'{"jsonClass":"Nope"}',
+        headers={"content-type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=2) as resp:
+        assert json.loads(resp.read())["status"] == "OK"
+    client = WebClient(url)
+    assert client.get_stats() == Stats()  # cache untouched
+
+
+def test_static_dashboard_served(server):
+    _, url, _ = server
+    import urllib.request
+
+    with urllib.request.urlopen(url + "/", timeout=2) as resp:
+        body = resp.read().decode()
+    assert "twtml-tpu" in body and 'id="mse"' in body
+    with urllib.request.urlopen(url + "/js/api.js", timeout=2) as resp:
+        assert b"websocketOn" in resp.read()
+    with pytest.raises(Exception):
+        urllib.request.urlopen(url + "/definitely-missing", timeout=2)
+
+
+def test_config_persistence_roundtrip(tmp_path):
+    backup = str(tmp_path / "twtml-web.json")
+    cache = ApiCache(backup_file=backup)
+    cache.cache('{"jsonClass":"Config","id":"a","host":"h","viz":["1"]}')
+    cache.cache('{"jsonClass":"Stats","count":5,"batch":1,"mse":2,'
+                '"realStddev":3,"predStddev":4}')
+    # fresh cache restores Config only (ApiCache.scala:27-31,50-56)
+    fresh = ApiCache(backup_file=backup)
+    fresh.restore()
+    assert json.loads(fresh.config())["id"] == "a"
+    assert json.loads(fresh.stats())["count"] == 0
+
+
+def test_websocket_broadcast_and_connect_push(server):
+    _, url, _ = server
+    ws_url = url.replace("http://", "ws://") + "/api"
+    client = WebClient(url)
+    client.config("cfg-1", "http://lightning", ["viz-9"])
+
+    async def scenario():
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.ws_connect(ws_url) as ws1, \
+                    session.ws_connect(ws_url) as ws2:
+                # on-connect push: cached Config to each new socket
+                first1 = json.loads((await ws1.receive(timeout=5)).data)
+                first2 = json.loads((await ws2.receive(timeout=5)).data)
+                assert first1["jsonClass"] == first2["jsonClass"] == "Config"
+                assert first1["id"] == "cfg-1"
+                # a frame sent by one socket is broadcast to ALL (incl sender)
+                payload = {"jsonClass": "Stats", "count": 7, "batch": 7,
+                           "mse": 7, "realStddev": 7, "predStddev": 7}
+                await ws1.send_str(json.dumps(payload))
+                echo1 = json.loads((await ws1.receive(timeout=5)).data)
+                echo2 = json.loads((await ws2.receive(timeout=5)).data)
+                assert echo1 == echo2 == payload
+        # and an HTTP POST is broadcast to websockets too
+        return True
+
+    assert asyncio.run(scenario())
+    # the WS frame also updated the HTTP-readable cache
+    assert client.get_stats().count == 7
+
+
+def test_http_post_broadcasts_to_websockets(server):
+    _, url, _ = server
+    ws_url = url.replace("http://", "ws://") + "/api"
+
+    async def scenario():
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.ws_connect(ws_url) as ws:
+                await ws.receive(timeout=5)  # connect push
+                WebClient(url).stats(11, 2, 3, 4, 5)
+                frame = json.loads((await ws.receive(timeout=5)).data)
+                assert frame["jsonClass"] == "Stats" and frame["count"] == 11
+
+    asyncio.run(scenario())

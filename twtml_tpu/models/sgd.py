@@ -46,6 +46,93 @@ DENSE_TEXT_FEATURE_LIMIT = 8192
 MLLIB_SAMPLING_SEED = 42  # GradientDescent samples with seed 42+i
 
 
+def sgd_inner_loop(
+    weights,
+    *,
+    num_iterations: int,
+    step_size: float,
+    mini_batch_fraction: float,
+    l2_reg: float,
+    convergence_tol: float,
+    mask,
+    sample_key,
+    grad_and_count: Callable,
+    norm_sq: Callable | None = None,
+):
+    """The MLlib GradientDescent iteration loop over an arbitrary weight
+    pytree — the ONE place the parity-critical semantics live (1-indexed
+    eta = stepSize/√i, SquaredL2Updater pre-scale, Bernoulli sampling,
+    zero-sample skip, convergence test on successive weight vectors,
+    converged-freeze). Both the single-device step below and the
+    feature-sharded step (parallel/sharding.py) drive it.
+
+    ``grad_and_count(w, sel)`` must return (gradient-sum pytree, selected
+    count), already globally reduced across any mesh axes. ``norm_sq(a, b)``
+    returns the global ‖a−b‖² for convergence (default: local sum over
+    leaves; sharded layouts pass a psum-ing version).
+    """
+    dtype = jax.tree_util.tree_leaves(weights)[0].dtype
+
+    if norm_sq is None:
+        def norm_sq(a, b):
+            return sum(
+                jnp.sum((la - lb) ** 2)
+                for la, lb in zip(
+                    jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+                )
+            )
+
+    def body(i, carry):
+        w, converged = carry
+        it = i + 1  # MLlib iterations are 1-indexed
+        if mini_batch_fraction < 1.0:
+            sel = mask * jax.random.bernoulli(
+                jax.random.fold_in(sample_key, it), mini_batch_fraction, mask.shape
+            ).astype(dtype)
+        else:
+            sel = mask
+        grad_sum, count = grad_and_count(w, sel)
+        denom = jnp.maximum(count, 1.0)
+        eta = step_size / jnp.sqrt(jnp.asarray(it, dtype))
+        w_new = jax.tree_util.tree_map(
+            lambda wl, gl: wl * (1.0 - eta * l2_reg) - eta * gl / denom, w, grad_sum
+        )
+        # zero sampled points → no update (MLlib warns and skips)
+        w_new = jax.tree_util.tree_map(
+            lambda nl, wl: jnp.where(count > 0, nl, wl), w_new, w
+        )
+        if convergence_tol > 0:
+            delta = jnp.sqrt(norm_sq(w_new, w))
+            norm_new = jnp.sqrt(
+                norm_sq(w_new, jax.tree_util.tree_map(jnp.zeros_like, w_new))
+            )
+            # a zero-sample iteration is a skip, not convergence
+            conv_now = (count > 0) & (
+                delta < convergence_tol * jnp.maximum(norm_new, 1.0)
+            )
+        else:
+            conv_now = jnp.array(False)
+        w_out = jax.tree_util.tree_map(
+            lambda wl, nl: jnp.where(converged, wl, nl), w, w_new
+        )
+        return w_out, converged | conv_now
+
+    w_final, _ = lax.fori_loop(0, num_iterations, body, (weights, jnp.array(False)))
+    return w_final
+
+
+def sampling_key(axis_name: str | None, mini_batch_fraction: float):
+    """MLlib-compatible sampling key (seed 42, GradientDescent's 42+i), with
+    the data-shard index folded in under shard_map so shards draw independent
+    masks. Sampled subsets therefore differ between mesh layouts (as they do
+    between Spark partitionings) but are statistically equivalent;
+    fraction=1.0 (the default) is exact."""
+    key = jax.random.PRNGKey(MLLIB_SAMPLING_SEED)
+    if axis_name and mini_batch_fraction < 1.0:
+        key = jax.random.fold_in(key, lax.axis_index(axis_name))
+    return key
+
+
 def make_sgd_train_step(
     *,
     num_text_features: int,
@@ -117,51 +204,25 @@ def make_sgd_train_step(
         stats = batch_stats(labels, preds, mask, axis_name)
 
         # ---- numIterations of mini-batch SGD ----------------------------
-        # Sampling keys: seed 42 like MLlib (GradientDescent's 42+i), with the
-        # data-shard index folded in under shard_map so shards draw
-        # independent masks. Sampled subsets therefore differ between mesh
-        # layouts (as they do between Spark partitionings) but are
-        # statistically equivalent; fraction=1.0 (the default) is exact.
-        base_key = jax.random.PRNGKey(MLLIB_SAMPLING_SEED)
-        if axis_name and mini_batch_fraction < 1.0:
-            base_key = jax.random.fold_in(base_key, lax.axis_index(axis_name))
-
-        def body(i, carry):
-            w, converged = carry
-            it = i + 1  # MLlib iterations are 1-indexed
-            if mini_batch_fraction < 1.0:
-                sel = mask * jax.random.bernoulli(
-                    jax.random.fold_in(base_key, it),
-                    mini_batch_fraction,
-                    mask.shape,
-                ).astype(dtype)
-            else:
-                sel = mask
+        def grad_and_count(w, sel):
             residual = residual_fn(_predict_raw(w, batch, x_dense), labels) * sel
             grad_sum = _grad_sum(batch, x_dense, residual)
             count = jnp.sum(sel)
             if axis_name:
                 grad_sum = lax.psum(grad_sum, axis_name)
                 count = lax.psum(count, axis_name)
-            grad = grad_sum / jnp.maximum(count, 1.0)
-            eta = step_size / jnp.sqrt(jnp.asarray(it, dtype))
-            w_new = w * (1.0 - eta * l2_reg) - eta * grad
-            # zero sampled points → no update (MLlib warns and skips)
-            w_new = jnp.where(count > 0, w_new, w)
-            if convergence_tol > 0:
-                delta = jnp.linalg.norm(w_new - w)
-                # a zero-sample iteration is a skip, not convergence
-                conv_now = (count > 0) & (
-                    delta
-                    < convergence_tol * jnp.maximum(jnp.linalg.norm(w_new), 1.0)
-                )
-            else:
-                conv_now = jnp.array(False)
-            w_out = jnp.where(converged, w, w_new)
-            return w_out, converged | conv_now
+            return grad_sum, count
 
-        w_final, _ = lax.fori_loop(
-            0, num_iterations, body, (weights, jnp.array(False))
+        w_final = sgd_inner_loop(
+            weights,
+            num_iterations=num_iterations,
+            step_size=step_size,
+            mini_batch_fraction=mini_batch_fraction,
+            l2_reg=l2_reg,
+            convergence_tol=convergence_tol,
+            mask=mask,
+            sample_key=sampling_key(axis_name, mini_batch_fraction),
+            grad_and_count=grad_and_count,
         )
         return w_final, StepOutput(predictions=preds, **stats)
 

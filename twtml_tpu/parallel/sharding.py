@@ -32,7 +32,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..features.batch import NUM_NUMBER_FEATURES, FeatureBatch
 from ..models.base import StepOutput
-from ..models.sgd import MLLIB_SAMPLING_SEED, make_sgd_train_step
+from ..models.sgd import make_sgd_train_step, sampling_key, sgd_inner_loop
 from ..ops.sparse import sparse_grad_text, sparse_text_dot
 from ..ops.stats import batch_stats
 from ..utils.rounding import jnp_round_half_up
@@ -93,63 +93,45 @@ def _make_feature_sharded_step(
         rel = jnp.clip(rel, 0, f_text_local - 1)
         local_val = token_val * in_slice  # zero out tokens outside this slice
 
-        def predict(wt, wn):
-            part = sparse_text_dot(wt, rel, local_val)
-            return lax.psum(part, model_axis) + numeric @ wn
+        def predict(w):
+            part = sparse_text_dot(w["text"], rel, local_val)
+            return lax.psum(part, model_axis) + numeric @ w["num"]
 
         # ---- predict + stats with pre-update weights --------------------
-        preds = prediction_fn(predict(w_text, w_num))
+        preds = prediction_fn(predict(weights))
         if round_predictions:
             preds = jnp_round_half_up(preds)
         stats = batch_stats(labels, preds, mask, data_axis)
 
-        base_key = jax.random.PRNGKey(MLLIB_SAMPLING_SEED)
-        shard_key = jax.random.fold_in(base_key, lax.axis_index(data_axis))
-
-        def body(i, carry):
-            wt, wn, converged = carry
-            it = i + 1
-            if mini_batch_fraction < 1.0:
-                sel = mask * jax.random.bernoulli(
-                    jax.random.fold_in(shard_key, it),
-                    mini_batch_fraction,
-                    mask.shape,
-                ).astype(dtype)
-            else:
-                sel = mask
-            residual = residual_fn(predict(wt, wn), labels) * sel
+        # ---- the shared MLlib iteration loop over the sharded pytree ----
+        def grad_and_count(w, sel):
+            residual = residual_fn(predict(w), labels) * sel
             g_text = lax.psum(
                 sparse_grad_text(rel, local_val, residual, f_text_local), data_axis
             )
             g_num = lax.psum(residual @ numeric, data_axis)
             count = lax.psum(jnp.sum(sel), data_axis)
-            denom = jnp.maximum(count, 1.0)
-            eta = step_size / jnp.sqrt(jnp.asarray(it, dtype))
-            wt_new = wt * (1.0 - eta * l2_reg) - eta * g_text / denom
-            wn_new = wn * (1.0 - eta * l2_reg) - eta * g_num / denom
-            wt_new = jnp.where(count > 0, wt_new, wt)
-            wn_new = jnp.where(count > 0, wn_new, wn)
-            if convergence_tol > 0:
-                delta_sq = lax.psum(jnp.sum((wt_new - wt) ** 2), model_axis) + jnp.sum(
-                    (wn_new - wn) ** 2
-                )
-                norm_sq = lax.psum(jnp.sum(wt_new**2), model_axis) + jnp.sum(
-                    wn_new**2
-                )
-                conv_now = (count > 0) & (
-                    jnp.sqrt(delta_sq)
-                    < convergence_tol * jnp.maximum(jnp.sqrt(norm_sq), 1.0)
-                )
-            else:
-                conv_now = jnp.array(False)
-            wt_out = jnp.where(converged, wt, wt_new)
-            wn_out = jnp.where(converged, wn, wn_new)
-            return wt_out, wn_out, converged | conv_now
+            return {"text": g_text, "num": g_num}, count
 
-        w_text, w_num, _ = lax.fori_loop(
-            0, num_iterations, body, (w_text, w_num, jnp.array(False))
+        def norm_sq(a, b):
+            # text slices live on the model axis; num is replicated there
+            return lax.psum(jnp.sum((a["text"] - b["text"]) ** 2), model_axis) + (
+                jnp.sum((a["num"] - b["num"]) ** 2)
+            )
+
+        w_final = sgd_inner_loop(
+            {"text": w_text, "num": w_num},
+            num_iterations=num_iterations,
+            step_size=step_size,
+            mini_batch_fraction=mini_batch_fraction,
+            l2_reg=l2_reg,
+            convergence_tol=convergence_tol,
+            mask=mask,
+            sample_key=sampling_key(data_axis, mini_batch_fraction),
+            grad_and_count=grad_and_count,
+            norm_sq=norm_sq,
         )
-        return {"text": w_text, "num": w_num}, StepOutput(predictions=preds, **stats)
+        return w_final, StepOutput(predictions=preds, **stats)
 
     return step
 

@@ -1,0 +1,4 @@
+from .cache import ApiCache
+from .server import Server
+
+__all__ = ["ApiCache", "Server"]

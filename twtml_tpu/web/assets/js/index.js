@@ -1,0 +1,47 @@
+// Dashboard logic (reference: web/src/main/assets/js/index.js — dispatch on
+// jsonClass; Config rebuilds the chart iframes, Stats updates the counters).
+(function () {
+  "use strict";
+
+  const ids = ["count", "batch", "mse", "realStddev", "predStddev"];
+
+  function onConfig(json) {
+    for (const id of ids) document.getElementById(id).textContent = "0";
+    document.getElementById("session").textContent = json.id || "—";
+    const graphs = document.getElementById("graphs");
+    graphs.replaceChildren();
+    for (const vizId of json.viz || []) {
+      // the reference embeds Lightning charts via pym
+      // (js/index.js:35-43: host + "/visualizations/" + id + "/pym")
+      const frame = document.createElement("iframe");
+      frame.src = json.host + "/visualizations/" + vizId + "/pym";
+      frame.title = "viz " + vizId;
+      graphs.appendChild(frame);
+    }
+  }
+
+  function onStats(json) {
+    for (const id of ids) {
+      document.getElementById(id).textContent = Number(json[id]).toLocaleString();
+    }
+  }
+
+  function onMessage(json) {
+    switch (json.jsonClass) {
+      case "Config": onConfig(json); break;
+      case "Stats": onStats(json); break;
+      case "_Socket": {
+        const badge = document.getElementById("conn");
+        badge.textContent = json.open ? "live" : "offline";
+        badge.classList.toggle("live", !!json.open);
+        break;
+      }
+    }
+  }
+
+  document.addEventListener("DOMContentLoaded", () => {
+    api.bind(onMessage);
+    api.websocketOn();
+    api.getStats().then(onStats).catch(() => {});
+  });
+})();

@@ -1,0 +1,63 @@
+"""Last-value API cache with config persistence (reference: ApiCache.scala).
+
+Holds the most recent Stats and Config JSON. Only Config survives restarts:
+it is backed up to ``{tmpdir}/twtml-web.json`` on every cacheConfig
+(ApiCache.scala:27-31,54-56) and restored at boot unless ``-nocache``
+(Main.scala:12-14) — so reconnecting dashboards can re-embed their charts
+while stats restart from zero (SURVEY.md §2.5 "Stats survive only in memory;
+Config survives restarts").
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+from ..telemetry.api_types import Config, Stats, decode, encode
+from ..utils import get_logger
+
+log = get_logger("web.cache")
+
+BACKUP_FILE = os.path.join(tempfile.gettempdir(), "twtml-web.json")
+
+
+class ApiCache:
+    def __init__(self, backup_file: str = BACKUP_FILE):
+        self.backup_file = backup_file
+        self._stats = Stats()
+        self._config = Config()
+
+    def config(self) -> str:
+        return encode(self._config)
+
+    def stats(self) -> str:
+        return encode(self._stats)
+
+    def cache(self, json_text: str) -> None:
+        """Dispatch on the jsonClass hint (ApiCache.scala:41-48); unknown
+        payloads are logged and dropped."""
+        try:
+            data = decode(json_text)
+        except Exception:
+            # log-and-drop contract (ApiCache.scala:47): a malformed payload
+            # must never 500 a POST or tear down a websocket
+            log.error("json not recognized: %s", json_text)
+            return
+        if isinstance(data, Stats):
+            log.debug("caching stats")
+            self._stats = data
+        else:
+            log.debug("caching config")
+            self._config = data
+            self.backup()
+
+    def backup(self) -> None:
+        with open(self.backup_file, "w", encoding="utf-8") as fh:
+            fh.write(self.config())
+
+    def restore(self) -> None:
+        try:
+            with open(self.backup_file, encoding="utf-8") as fh:
+                self.cache(fh.read())
+        except Exception:
+            pass  # best-effort, like the Try at ApiCache.scala:50-52

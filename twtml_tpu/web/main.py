@@ -1,0 +1,42 @@
+"""Standalone web-server entry (reference: Main.scala:7-23).
+
+``python -m twtml_tpu.web.main [-nocache]`` — restores the persisted Config
+unless ``-nocache`` is given, honors the ``PORT`` env var (Heroku
+compatibility, Server.scala:66), and stops cleanly on SIGINT/SIGTERM (the
+reference's JVM shutdown hook)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+
+from ..utils import get_logger
+from .cache import ApiCache
+from .server import Server
+
+log = get_logger("web.main")
+
+
+def main(argv: list[str] | None = None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    cache = ApiCache()
+    if "-nocache" not in args:
+        cache.restore()
+
+    port = int(os.environ.get("PORT", "8888"))
+    server = Server(port=port, cache=cache)
+
+    def shutdown(_sig, _frame):
+        log.info("shutting down")
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, shutdown)
+    try:
+        server.run_forever()
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

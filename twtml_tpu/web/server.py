@@ -1,0 +1,177 @@
+"""Dashboard web server (reference: twtml-web's Socko server, Server.scala +
+ApiHandler.scala).
+
+Same route surface and broadcast semantics as the reference:
+
+- ``POST /api``        → cache payload, respond ``{"status":"OK"}``, broadcast
+                         the raw JSON to every live websocket
+                         (ApiHandler.scala:50-57);
+- ``GET /api/config``  → cached Config JSON (ApiHandler.scala:38-42);
+- ``GET /api/stats``   → cached Stats JSON (ApiHandler.scala:44-48);
+- ``WS /api``          → on connect, push the cached Config to the new socket
+                         (ApiHandler.scala:68-73); every inbound frame is
+                         cached and broadcast to ALL sockets including the
+                         sender (ApiHandler.scala:59-67);
+- ``GET /``            → dashboard index, ``GET /*`` → static assets
+                         (Server.scala:54-59), 404 otherwise.
+
+Netty/Akka actors become one asyncio event loop (aiohttp); the per-message
+fire-once actor pattern is just a coroutine per request. ``start_background``
+runs the loop in a daemon thread so tests and the training CLI can embed the
+server in-process — the pattern the reference's WebTestSuite used by calling
+Main.main directly (WebTestSuite.scala:22).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import mimetypes
+import threading
+from importlib import resources as _res
+
+from aiohttp import WSMsgType, web
+
+from ..utils import get_logger
+from .cache import ApiCache
+
+log = get_logger("web.server")
+
+OK = json.dumps({"status": "OK"})
+
+
+class Server:
+    def __init__(self, port: int = 8888, host: str = "0.0.0.0",
+                 cache: ApiCache | None = None):
+        self.port = port
+        self.host = host
+        self.cache = cache if cache is not None else ApiCache()
+        self._websockets: set[web.WebSocketResponse] = set()
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._runner: web.AppRunner | None = None
+        self._started = threading.Event()
+        self._assets = _res.files("twtml_tpu.web").joinpath("assets")
+
+    # -- handlers ------------------------------------------------------------
+    async def _post_api(self, request: web.Request) -> web.StreamResponse:
+        text = await request.text()
+        log.debug("http - post data %s", text)
+        self.cache.cache(text)
+        await self._broadcast(text)
+        return web.Response(text=OK, content_type="application/json")
+
+    async def _get_config(self, request: web.Request) -> web.StreamResponse:
+        return web.Response(text=self.cache.config(), content_type="application/json")
+
+    async def _get_stats(self, request: web.Request) -> web.StreamResponse:
+        return web.Response(text=self.cache.stats(), content_type="application/json")
+
+    async def _ws_api(self, request: web.Request) -> web.StreamResponse:
+        ws = web.WebSocketResponse(heartbeat=30)
+        await ws.prepare(request)
+        self._websockets.add(ws)
+        log.debug("websocket connected (%d live)", len(self._websockets))
+        try:
+            await ws.send_str(self.cache.config())  # WsStartHandler behavior
+            async for msg in ws:
+                if msg.type == WSMsgType.TEXT:
+                    self.cache.cache(msg.data)
+                    await self._broadcast(msg.data)
+                elif msg.type == WSMsgType.ERROR:
+                    break
+        finally:
+            self._websockets.discard(ws)
+        return ws
+
+    async def _broadcast(self, text: str) -> None:
+        """Fan a frame out to every dashboard (webSocketConnections.writeText
+        equivalent); dead sockets are dropped silently."""
+        for ws in list(self._websockets):
+            try:
+                await ws.send_str(text)
+            except Exception:
+                self._websockets.discard(ws)
+
+    async def _index(self, request: web.Request) -> web.StreamResponse:
+        return self._static_file("index.html")
+
+    async def _static(self, request: web.Request) -> web.StreamResponse:
+        rel = request.match_info["path"]
+        return self._static_file(rel)
+
+    def _static_file(self, rel: str) -> web.StreamResponse:
+        if ".." in rel:
+            raise web.HTTPNotFound
+        target = self._assets.joinpath(rel)
+        if not target.is_file():
+            raise web.HTTPNotFound
+        ctype, _ = mimetypes.guess_type(rel)
+        return web.Response(body=target.read_bytes(),
+                            content_type=ctype or "application/octet-stream")
+
+    # -- lifecycle -----------------------------------------------------------
+    def _build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/api", self._ws_api)  # websocket handshake
+        app.router.add_post("/api", self._post_api)
+        app.router.add_get("/api/config", self._get_config)
+        app.router.add_get("/api/stats", self._get_stats)
+        app.router.add_get("/", self._index)
+        app.router.add_get("/{path:.+}", self._static)
+        return app
+
+    async def _start_async(self) -> None:
+        self._runner = web.AppRunner(self._build_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        log.info("Open your browser and navigate to http://%s:%d",
+                 self.host, self.port)
+
+    async def _stop_async(self) -> None:
+        for ws in list(self._websockets):
+            try:
+                await ws.close()
+            except Exception:
+                pass
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    def start_background(self) -> "Server":
+        """Run the server loop in a daemon thread; returns once listening."""
+        def runner():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            self._loop.run_until_complete(self._start_async())
+            self._started.set()
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=runner, name="twtml-web", daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("web server failed to start")
+        return self
+
+    def stop(self) -> None:
+        if self._loop is None:
+            return
+        fut = asyncio.run_coroutine_threadsafe(self._stop_async(), self._loop)
+        try:
+            fut.result(timeout=5)
+        except Exception:
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def run_forever(self) -> None:
+        """Foreground mode for the standalone process (web.main)."""
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+        loop.run_until_complete(self._start_async())
+        try:
+            loop.run_forever()
+        finally:
+            loop.run_until_complete(self._stop_async())
