@@ -1,0 +1,72 @@
+"""Secondary model apps: k-means entry (KMeans.scala parity), logistic
+sentiment entry (BASELINE config #3), and the per-batch standard scaler."""
+
+import os
+
+import numpy as np
+import pytest
+
+from twtml_tpu.config import ConfArguments
+from twtml_tpu.features.featurizer import Status
+from twtml_tpu.features.sentiment import sentiment_label, sentiment_score
+from twtml_tpu.ops.scaler import standard_scale
+
+DATA = os.path.join(os.path.dirname(__file__), "data", "tweets.jsonl")
+
+
+def test_standard_scaler_matches_mllib_semantics():
+    pts = np.array([[1.0, 5.0], [3.0, 5.0], [5.0, 5.0]], np.float32)
+    mask = np.ones((3,), np.float32)
+    out = np.asarray(standard_scale(pts, mask))
+    # col 0: sample std of [1,3,5] = 2 → scaled [0.5, 1.5, 2.5]
+    np.testing.assert_allclose(out[:, 0], [0.5, 1.5, 2.5], rtol=1e-6)
+    # col 1: zero std → 0.0 (MLlib StandardScalerModel)
+    np.testing.assert_allclose(out[:, 1], [0.0, 0.0, 0.0])
+
+
+def test_standard_scaler_masked_rows_excluded():
+    pts = np.array([[1.0, 1.0], [3.0, 1.0], [999.0, 999.0]], np.float32)
+    mask = np.array([1.0, 1.0, 0.0], np.float32)
+    out = np.asarray(standard_scale(pts, mask))
+    assert out[2].tolist() == [0.0, 0.0]  # padding zeroed
+    np.testing.assert_allclose(
+        out[:2, 0], pts[:2, 0] / np.std(pts[:2, 0], ddof=1), rtol=1e-6
+    )
+
+
+def test_sentiment_labeler():
+    assert sentiment_score("I love this great day") > 0
+    assert sentiment_score("terrible awful mess") < 0
+    pos = Status(retweeted_status=Status(text="what a wonderful result"))
+    neg = Status(retweeted_status=Status(text="this is the worst fail"))
+    assert sentiment_label(pos) == 1.0
+    assert sentiment_label(neg) == 0.0
+
+
+def conf_for(app_args):
+    return ConfArguments().parse([
+        "--source", "replay", "--replayFile", DATA, "--seconds", "1",
+        "--backend", "cpu",
+        "--lightning", "http://127.0.0.1:9", "--twtweb", "http://127.0.0.1:9",
+        *app_args,
+    ])
+
+
+def test_kmeans_app_on_replay(capsys):
+    from twtml_tpu.apps.kmeans import run
+
+    totals = run(conf_for([]), wall_clock=False)
+    # the k-means filter keeps ALL retweets (8 in the fixture), not just the
+    # [100,1000] interval the linear app uses
+    assert totals["count"] == 8
+    out = capsys.readouterr().out
+    assert "centers:" in out and "sizes:" in out
+
+
+def test_logistic_app_on_replay(capsys):
+    from twtml_tpu.apps.logistic_regression import run
+
+    totals = run(conf_for([]))
+    assert totals["count"] == 6
+    out = capsys.readouterr().out
+    assert "errRate:" in out

@@ -1,0 +1,116 @@
+"""Streaming k-means entry point (reference: KMeans.scala:49-170).
+
+Pipeline kept equivalent: retweets only (``isRetweet`` — NO retweet-interval
+filter here, unlike the linear app, KMeans.scala:77-80), featurized to the
+dense pair (original's retweetCount, original's followersCount)
+(KMeans.scala:19-33), per-batch StandardScaler(false, true), manual
+``update(scaled, decayFactor, timeUnit)`` on a k=3 half-life-5-batches model
+with random 2-d centers (KMeans.scala:69-73,103-105), then per-batch debug
+output of centers and assignments (the reference's charts are all commented
+out, KMeans.scala:115-133 — we print the same values it logs).
+
+Run: ``python -m twtml_tpu.apps.kmeans --source replay --replayFile ...``
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import numpy as np
+
+from ..config import ConfArguments
+from ..features.batch import _bucket
+from ..features.featurizer import Status
+from ..models.kmeans import StreamingKMeans
+from ..ops.scaler import standard_scale
+from ..streaming.context import StreamingContext
+from ..streaming.sources import Source
+from ..utils import get_logger
+from .linear_regression import build_source, select_backend
+
+log = get_logger("apps.kmeans")
+
+NUM_DIMENSIONS = 2  # KMeans.scala:57
+NUM_CLUSTERS = 3  # KMeans.scala:58
+
+
+def featurize(status: Status) -> np.ndarray:
+    """Dense (retweetCount, followersCount) of the original tweet
+    (KMeans.scala:19-33)."""
+    original = status.retweeted_status
+    return np.array(
+        [float(original.retweet_count), float(original.followers_count)],
+        dtype=np.float32,
+    )
+
+
+def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> dict:
+    select_backend(conf)
+    source: Source = build_source(conf)
+
+    model = (
+        StreamingKMeans()
+        .set_k(NUM_CLUSTERS)
+        .set_half_life(5, "batches")
+        .set_random_centers(NUM_DIMENSIONS, 0.0)
+    )
+    scale = jax.jit(standard_scale)
+    ssc = StreamingContext(batch_interval=conf.seconds)
+    totals = {"count": 0, "batches": 0}
+
+    def on_batch(statuses: list[Status], _batch_time) -> None:
+        retweets = [s for s in statuses if s.is_retweet]  # KMeans.scala:77-80
+        if not retweets:
+            log.debug("batch: 0")
+            return
+        n = len(retweets)
+        # pad rows to a power-of-two bucket so XLA compiles a handful of
+        # shapes, not one per batch size (same policy as features/batch.py)
+        rows = _bucket(n)
+        pts = np.zeros((rows, NUM_DIMENSIONS), np.float32)
+        pts[:n] = np.stack([featurize(s) for s in retweets])
+        mask = np.zeros((rows,), np.float32)
+        mask[:n] = 1.0
+        scaled = np.asarray(scale(pts, mask))
+        assign = model.update(scaled, mask)[:n]
+        pred = model.predict(scaled[:n])
+        totals["count"] += n
+        totals["batches"] += 1
+        centers = model.latest_centers
+        print(
+            f"count: {totals['count']}  batch: {n}  "
+            f"centers: {np.round(centers, 3).tolist()}  "
+            f"sizes: {np.bincount(pred, minlength=NUM_CLUSTERS).tolist()}",
+            flush=True,
+        )
+        log.debug("assignments: %s", assign.tolist())
+        if max_batches and totals["batches"] >= max_batches:
+            ssc._stop.set()
+
+    ssc.raw_stream(source).foreach_batch(on_batch)
+    if wall_clock:
+        ssc.start()
+        try:
+            ssc.await_termination()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            ssc.stop()
+    else:
+        ssc.run_to_completion()
+    return totals
+
+
+def main(argv=None) -> None:
+    conf = (
+        ConfArguments()
+        .setAppName("twitter-stream-ml-kmeans")
+        .parse(list(sys.argv[1:] if argv is None else argv))
+    )
+    totals = run(conf)
+    log.info("done: %s tweets in %s batches", totals["count"], totals["batches"])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Streaming logistic-regression entry point (BASELINE config #3: binary
+sentiment on the tweet stream).
+
+Same pipeline shape as the linear app (filter → featurize → fused
+predict-then-train → stats) with the label swapped to the lexicon sentiment
+of the original tweet (features/sentiment.py) and the logistic learner
+(models/logistic.py). Reported ``mse`` over hard 0/1 predictions is the
+misclassification rate.
+
+Run: ``python -m twtml_tpu.apps.logistic_regression --source replay \
+      --replayFile tests/data/tweets.jsonl --seconds 1``
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from ..config import ConfArguments
+from ..features.featurizer import Featurizer
+from ..features.sentiment import sentiment_label
+from ..models.logistic import StreamingLogisticRegressionWithSGD
+from ..streaming.context import StreamingContext
+from ..telemetry.session_stats import SessionStats
+from ..utils import get_logger, round_half_up
+from .linear_regression import build_source, select_backend
+
+log = get_logger("apps.logistic")
+
+
+def run(conf: ConfArguments, max_batches: int = 0) -> dict:
+    session = SessionStats(conf).open()
+    select_backend(conf)
+    featurizer = Featurizer.from_conf(conf)
+    featurizer.label_fn = sentiment_label
+    model = StreamingLogisticRegressionWithSGD.from_conf(conf)
+
+    ssc = StreamingContext(batch_interval=conf.seconds)
+    stream = ssc.source_stream(
+        build_source(conf), featurizer, row_bucket=conf.batchBucket
+    )
+    totals = {"count": 0, "batches": 0}
+
+    def on_batch(batch, _batch_time) -> None:
+        if batch.num_valid == 0:
+            log.debug("batch: 0")
+            return
+        out = model.step(batch)
+        b = int(out.count)
+        totals["count"] += b
+        totals["batches"] += 1
+        err_rate = float(out.mse)  # 0/1 preds → MSE == misclassification rate
+        valid = batch.mask.astype(bool)
+        real = batch.label[valid].astype(np.float64)
+        pred = np.asarray(out.predictions)[valid].astype(np.float64)
+        print(
+            f"count: {totals['count']}  batch: {b}  errRate: {err_rate:.3f}  "
+            f"posRate (real, pred): ({real.mean():.2f}, {pred.mean():.2f})",
+            flush=True,
+        )
+        session.update(
+            totals["count"], b,
+            round_half_up(err_rate * 100),  # percent for the int dashboard field
+            round_half_up(float(out.real_stdev) * 100),
+            round_half_up(float(out.pred_stdev) * 100),
+            real, pred,
+        )
+        if max_batches and totals["batches"] >= max_batches:
+            ssc._stop.set()
+
+    stream.foreach_batch(on_batch)
+    ssc.start()
+    try:
+        ssc.await_termination()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        ssc.stop()
+    return totals
+
+
+def main(argv=None) -> None:
+    conf = (
+        ConfArguments()
+        .setAppName("twitter-stream-ml-logistic-regression")
+        .parse(list(sys.argv[1:] if argv is None else argv))
+    )
+    totals = run(conf)
+    log.info("done: %s tweets in %s batches", totals["count"], totals["batches"])
+
+
+if __name__ == "__main__":
+    main()

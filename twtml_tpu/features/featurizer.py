@@ -26,7 +26,7 @@ import time
 import unicodedata
 from dataclasses import dataclass, field
 from email.utils import parsedate_to_datetime
-from typing import Any
+from typing import Any, Callable
 
 import numpy as np
 
@@ -108,6 +108,7 @@ class Featurizer:
     num_retweet_end: int = 1000  # MllibHelper.scala:16
     normalize_accents: bool = False  # reference computes-and-drops, §2.5
     now_ms: int | None = None  # fixed clock for deterministic replay; None=wall
+    label_fn: "Callable[[Status], float] | None" = None  # default: retweetCount
     num_number_features: int = field(default=NUM_NUMBER_FEATURES, init=False)
 
     @classmethod
@@ -161,11 +162,12 @@ class Featurizer:
         """Sparse text counts + dense numerics + label, the host-side half of
         the LabeledPoint assembly; the device half (scatter into a dense or
         sharded vector) lives in ops/sparse.py."""
-        return (
-            self.featurize_text(status),
-            self.featurize_numbers(status),
-            float(status.retweeted_status.retweet_count),
+        label = (
+            float(status.retweeted_status.retweet_count)
+            if self.label_fn is None
+            else float(self.label_fn(status))
         )
+        return (self.featurize_text(status), self.featurize_numbers(status), label)
 
     def featurize_batch(
         self,

@@ -1,0 +1,42 @@
+"""Tiny lexicon sentiment labeler for the streaming logistic model.
+
+BASELINE config #3 is "StreamingLogisticRegressionWithSGD (binary sentiment)
+on the same stream" — the reference repo has no sentiment code, so the label
+definition is ours: 1.0 when the original tweet's text contains at least as
+many positive-lexicon words as negative ones, else 0.0. Deterministic,
+dependency-free, and cheap enough for the hot path; swap ``label`` for a real
+classifier's output if one is available.
+"""
+
+from __future__ import annotations
+
+import re
+
+from .featurizer import Status
+
+POSITIVE = frozenset(
+    """good great awesome amazing love happy excellent fantastic wonderful best
+    beautiful fun win winning cool nice brilliant perfect thanks thank glad
+    excited super sweet favorite favourite enjoy enjoyed impressive stunning
+    delightful positive success successful""".split()
+)
+
+NEGATIVE = frozenset(
+    """bad terrible awful hate sad horrible worst ugly fail failing broken
+    angry annoying disappointing disappointed poor boring gross nasty sucks
+    suck wrong problem problems negative disaster painful worse useless""".split()
+)
+
+_WORD = re.compile(r"[a-z']+")
+
+
+def sentiment_score(text: str) -> int:
+    """#positive − #negative lexicon hits over lowercased word tokens."""
+    words = _WORD.findall(text.lower())
+    return sum(w in POSITIVE for w in words) - sum(w in NEGATIVE for w in words)
+
+
+def sentiment_label(status: Status) -> float:
+    """Binary label from the ORIGINAL tweet's text (featurization also reads
+    the original, MllibHelper.scala:42-44)."""
+    return 1.0 if sentiment_score(status.retweeted_status.text) >= 0 else 0.0

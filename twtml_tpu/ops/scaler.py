@@ -1,0 +1,24 @@
+"""Per-batch standard scaling (reference: MLlib ``new StandardScaler(false,
+true).fit(rdd).transform(rdd)`` in the k-means entry, KMeans.scala:103).
+
+``withMean=false, withStd=true``: divide each column by its standard
+deviation, leave centering alone. MLlib's summarizer uses the unbiased sample
+variance (n−1); columns with zero std map to 0.0 (StandardScalerModel's
+``if std != 0 value/std else 0``). Masked rows are excluded from the fit and
+zeroed in the output.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def standard_scale(points, mask):
+    """points [B,D], mask [B] → scaled [B,D] (jit-safe, mask-aware)."""
+    m = mask[:, None]
+    n = jnp.maximum(jnp.sum(mask), 1.0)
+    mean = jnp.sum(points * m, axis=0) / n
+    var = jnp.sum(((points - mean) * m) ** 2, axis=0) / jnp.maximum(n - 1.0, 1.0)
+    std = jnp.sqrt(var)
+    factor = jnp.where(std > 0, 1.0 / jnp.maximum(std, 1e-30), 0.0)
+    return points * factor[None, :] * m

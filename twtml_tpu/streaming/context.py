@@ -37,20 +37,33 @@ log = get_logger("streaming.context")
 BatchFn = Callable[[FeatureBatch, float], None]
 
 
-class FeatureStream:
-    """A stream of FeatureBatches with registered outputs (DStream analog)."""
+class RawStream:
+    """A stream of raw Status lists — for apps with their own featurization
+    (the k-means entry featurizes to a dense pair, KMeans.scala:19-33).
+    Outputs fire per micro-batch in registration order (reference: foreachRDD
+    at LinearRegression.scala:53, trainOn at :86)."""
+
+    def __init__(self):
+        self._outputs: list[Callable] = []
+
+    def foreach_batch(self, fn) -> "RawStream":
+        self._outputs.append(fn)
+        return self
+
+    def _process(self, statuses: list[Status], batch_time: float):
+        for fn in self._outputs:
+            fn(statuses, batch_time)
+
+
+class FeatureStream(RawStream):
+    """A RawStream whose outputs receive padded FeatureBatches instead of
+    Status lists (DStream.map(featurize) analog)."""
 
     def __init__(self, featurizer: Featurizer, row_bucket: int = 0, token_bucket: int = 0):
+        super().__init__()
         self.featurizer = featurizer
         self.row_bucket = row_bucket
         self.token_bucket = token_bucket
-        self._outputs: list[BatchFn] = []
-
-    def foreach_batch(self, fn: BatchFn) -> "FeatureStream":
-        """Register an output, fired per micro-batch in registration order
-        (reference: foreachRDD at LinearRegression.scala:53, trainOn at :86)."""
-        self._outputs.append(fn)
-        return self
 
     def _process(self, statuses: list[Status], batch_time: float) -> FeatureBatch:
         batch = self.featurizer.featurize_batch(
@@ -66,7 +79,7 @@ class StreamingContext:
         self.batch_interval = batch_interval
         self._queue: "queue.Queue[Status]" = queue.Queue()
         self._source: Source | None = None
-        self._stream: FeatureStream | None = None
+        self._stream: RawStream | None = None
         self._scheduler: threading.Thread | None = None
         self._stop = threading.Event()
         self._terminated = threading.Event()
@@ -86,6 +99,15 @@ class StreamingContext:
             raise ValueError("StreamingContext supports one source stream")
         self._source = source
         self._stream = FeatureStream(featurizer, row_bucket, token_bucket)
+        return self._stream
+
+    def raw_stream(self, source: Source) -> RawStream:
+        """Attach the source with no featurization — outputs receive the raw
+        Status list per micro-batch."""
+        if self._source is not None:
+            raise ValueError("StreamingContext supports one source stream")
+        self._source = source
+        self._stream = RawStream()
         return self._stream
 
     def _drain(self) -> list[Status]:
@@ -149,7 +171,7 @@ class StreamingContext:
         self._source.start(self._queue.put)
         n0 = self.batches_processed
         pending: list[Status] = []
-        while True:
+        while not self._stop.is_set():
             try:
                 pending.append(self._queue.get(timeout=0.05))
                 if len(pending) >= max_batch_size:
@@ -161,7 +183,7 @@ class StreamingContext:
                     # timeout and the exhausted flag being set
                     pending.extend(self._drain())
                     break
-        if pending:
+        if pending and not self._stop.is_set():
             self._run_batch(pending, time.time())
         self._terminated.set()
         return self.batches_processed - n0
