@@ -45,14 +45,24 @@ def measure(n_tweets: int = N_TWEETS, batch_size: int = BATCH) -> dict:
     for _ in range(WARMUP_BATCHES):
         model.step(warm)
 
+    # double-buffered pipeline: featurize chunk k+1 on a host thread while
+    # the device runs chunk k (SURVEY.md §7 hard part (c))
+    from concurrent.futures import ThreadPoolExecutor
+
+    chunks = [statuses[i : i + batch_size] for i in range(0, n_tweets, batch_size)]
+
+    def featurize(chunk):
+        return feat.featurize_batch(chunk, row_bucket=batch_size, pre_filtered=True)
+
     t0 = time.perf_counter()
-    done = 0
     last = None
-    while done < n_tweets:
-        chunk = statuses[done : done + batch_size]
-        batch = feat.featurize_batch(chunk, row_bucket=batch_size, pre_filtered=True)
-        last = model.step(batch)
-        done += len(chunk)
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        pending = pool.submit(featurize, chunks[0])
+        for nxt in chunks[1:]:
+            batch = pending.result()
+            pending = pool.submit(featurize, nxt)
+            last = model.step(batch)
+        last = model.step(pending.result())
     last.mse.block_until_ready()
     dt = time.perf_counter() - t0
     return {

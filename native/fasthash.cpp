@@ -1,0 +1,130 @@
+// Fast char-bigram HashingTF featurizer — the host-side hot loop in C++.
+//
+// Semantics are identical to twtml_tpu/features/hashing.py (the ground
+// truth): Java String.hashCode over UTF-16 code units per bigram
+// (h = 31*cu0 + cu1 in int32 arithmetic), nonNegativeMod into num_features,
+// term-frequency counts deduplicated per tweet. The Python caller lowercases
+// and encodes to UTF-16-LE (locale-correct, cheap CPython fast paths); this
+// code consumes raw code units — surrogate pairs therefore contribute their
+// two units exactly like the JVM, matching MllibHelper.scala:42-56 /
+// MLlib HashingTF.
+//
+// Build: g++ -O3 -shared -fPIC -o libfasthash.so fasthash.cpp
+// Loaded via ctypes (twtml_tpu/features/native.py); pure-Python fallback
+// remains authoritative for parity tests.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// Open-addressing scratch table for per-tweet term-frequency dedup.
+// Tweets cap at 280 chars -> <=279 bigrams; 1024 slots keep load < 0.28.
+constexpr int kTableSize = 1024;  // power of two
+constexpr int kTableMask = kTableSize - 1;
+
+struct Slot {
+  int32_t idx;   // hashed feature index, -1 = empty
+  float count;
+};
+
+inline int32_t non_negative_mod(int32_t x, int32_t m) {
+  int32_t r = x % m;           // C++ % truncates toward zero, like Java
+  return r < 0 ? r + m : r;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Featurize one micro-batch of lowercased UTF-16-LE texts.
+//
+//   units:        concatenated code units of all texts
+//   offsets:      B+1 prefix offsets into `units` (in code units)
+//   batch:        number of texts B
+//   num_features: HashingTF dimensionality
+//   l_max:        token capacity per row in the padded output
+//   out_idx:      [B, l_max] int32, caller-zeroed
+//   out_val:      [B, l_max] float32, caller-zeroed
+//   out_ntok:     [B] int32 — distinct hashed terms per tweet (may exceed
+//                 l_max; caller re-buckets and retries in that case)
+//
+// Returns the maximum distinct-term count seen (for bucket sizing).
+int32_t fasthash_batch(const uint16_t* units, const int64_t* offsets,
+                       int32_t batch, int32_t num_features, int32_t l_max,
+                       int32_t* out_idx, float* out_val, int32_t* out_ntok) {
+  Slot table[kTableSize];
+  int32_t max_terms = 0;
+
+  for (int32_t b = 0; b < batch; ++b) {
+    const int64_t start = offsets[b];
+    const int64_t end = offsets[b + 1];
+    const int64_t len = end - start;
+
+    // collect this tweet's distinct (index, count) pairs
+    int32_t used[kTableSize];
+    int32_t n_used = 0;
+
+    bool overflowed = false;
+    auto add_term = [&](int32_t h) {
+      // A full table has no empty slot to terminate the probe loop, and a
+      // new distinct term couldn't be inserted anyway — bail to the exact
+      // Python path before probing.
+      if (n_used == kTableSize) {
+        overflowed = true;
+        return;
+      }
+      const int32_t idx = non_negative_mod(h, num_features);
+      uint32_t probe = static_cast<uint32_t>(idx) & kTableMask;
+      while (true) {
+        Slot& s = table[probe];
+        if (s.idx == idx) {
+          s.count += 1.0f;
+          return;
+        }
+        if (s.idx < 0) {
+          s.idx = idx;
+          s.count = 1.0f;
+          used[n_used++] = static_cast<int32_t>(probe);
+          return;
+        }
+        probe = (probe + 1) & kTableMask;
+      }
+    };
+
+    for (int32_t i = 0; i < kTableSize; ++i) table[i].idx = -1;
+
+    if (len == 1) {
+      // sliding(2) on a 1-unit string yields the string itself
+      add_term(static_cast<int32_t>(units[start]));
+    } else {
+      for (int64_t i = start; i + 1 < end && !overflowed; ++i) {
+        // Java hashCode of the 2-unit string: 31*cu0 + cu1 (int32 wrap)
+        const int32_t h = static_cast<int32_t>(
+            31u * static_cast<uint32_t>(units[i]) +
+            static_cast<uint32_t>(units[i + 1]));
+        add_term(h);
+      }
+    }
+
+    if (overflowed) {
+      // >kTableSize distinct terms in one tweet: unambiguous sentinel so the
+      // Python caller falls back to the exact path
+      out_ntok[b] = -1;
+      continue;
+    }
+    out_ntok[b] = n_used;
+    if (n_used > max_terms) max_terms = n_used;
+    const int32_t n_emit = n_used < l_max ? n_used : l_max;
+    int32_t* row_idx = out_idx + static_cast<int64_t>(b) * l_max;
+    float* row_val = out_val + static_cast<int64_t>(b) * l_max;
+    for (int32_t j = 0; j < n_emit; ++j) {
+      const Slot& s = table[used[j]];
+      row_idx[j] = s.idx;
+      row_val[j] = s.count;
+    }
+  }
+  return max_terms;
+}
+
+}  // extern "C"

@@ -86,7 +86,7 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
         )
         log.debug("assignments: %s", assign.tolist())
         if max_batches and totals["batches"] >= max_batches:
-            ssc._stop.set()
+            ssc.request_stop()
 
     ssc.raw_stream(source).foreach_batch(on_batch)
     if wall_clock:

@@ -102,7 +102,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
             totals["count"], b, mse, real_stdev, pred_stdev, real, pred
         )
         if max_batches and totals["batches"] >= max_batches:
-            ssc._stop.set()
+            ssc.request_stop()
 
     stream.foreach_batch(on_batch)
 

@@ -67,7 +67,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
             real, pred,
         )
         if max_batches and totals["batches"] >= max_batches:
-            ssc._stop.set()
+            ssc.request_stop()
 
     stream.foreach_batch(on_batch)
     ssc.start()

@@ -176,7 +176,68 @@ class Featurizer:
         token_bucket: int = 0,
         pre_filtered: bool = False,
     ) -> FeatureBatch:
-        """Filter + featurize + pad a micro-batch of tweets."""
+        """Filter + featurize + pad a micro-batch of tweets.
+
+        Hot path: text hashing runs in the C++ extension (native/fasthash.cpp)
+        writing straight into the padded buffers, and the numeric/label
+        columns are assembled vectorized — the Python per-tweet path remains
+        as semantic ground truth and fallback."""
         keep = statuses if pre_filtered else [s for s in statuses if self.filtrate(s)]
+        fast = self._featurize_batch_native(keep, row_bucket, token_bucket)
+        if fast is not None:
+            return fast
         rows = [self.featurize(s) for s in keep]
         return pad_feature_batch(rows, row_bucket=row_bucket, token_bucket=token_bucket)
+
+    def _featurize_batch_native(
+        self, keep: list[Status], row_bucket: int, token_bucket: int
+    ) -> FeatureBatch | None:
+        from . import native
+        from .batch import _bucket
+
+        if self.normalize_accents or self.label_fn is not None:
+            return None  # python path handles the uncommon configurations
+        if not native.available():
+            return None
+        n = len(keep)
+        originals = [s.retweeted_status for s in keep]
+        texts = [o.text.lower() for o in originals]
+        # distinct bigrams per tweet can't exceed its UTF-16 unit count − 1
+        # (bigrams window over code units, like the JVM — astral chars count
+        # twice), so this token bucket only needs a retry in the pathological
+        # >1024-distinct-terms case where the C side signals fallback
+        max_tok = max(
+            (max(len(t.encode("utf-16-le")) // 2 - 1, 1) for t in texts), default=1
+        )
+        b = row_bucket if row_bucket >= n and row_bucket > 0 else _bucket(max(n, 1))
+        lt = (
+            token_bucket
+            if token_bucket >= max_tok and token_bucket > 0
+            else _bucket(max_tok)
+        )
+        token_idx = np.zeros((b, lt), dtype=np.int32)
+        token_val = np.zeros((b, lt), dtype=np.float32)
+        ntok = native.hash_texts(texts, self.num_text_features, token_idx, token_val)
+        if ntok is None:
+            return None
+
+        now = self.now_ms if self.now_ms is not None else int(time.time() * 1000)
+        numeric = np.zeros((b, NUM_NUMBER_FEATURES), dtype=np.float32)
+        label = np.zeros((b,), dtype=np.float32)
+        mask = np.zeros((b,), dtype=np.float32)
+        if n:
+            numeric[:n, 0] = np.fromiter(
+                (o.followers_count for o in originals), np.float64, n
+            ) * 1e-12
+            numeric[:n, 1] = np.fromiter(
+                (o.favourites_count for o in originals), np.float64, n
+            ) * 1e-12
+            numeric[:n, 2] = np.fromiter(
+                (o.friends_count for o in originals), np.float64, n
+            ) * 1e-12
+            numeric[:n, 3] = (
+                now - np.fromiter((o.created_at_ms for o in originals), np.float64, n)
+            ) * 1e-14
+            label[:n] = np.fromiter((o.retweet_count for o in originals), np.float64, n)
+            mask[:n] = 1.0
+        return FeatureBatch(token_idx, token_val, numeric, label, mask)

@@ -18,18 +18,22 @@ from collections import Counter
 from typing import Iterable
 
 
+def utf16_units(s: str) -> list[int]:
+    """The string as JVM chars: UTF-16 code units (surrogates split)."""
+    b = s.encode("utf-16-le", errors="surrogatepass")
+    return [b[i] | (b[i + 1] << 8) for i in range(0, len(b), 2)]
+
+
 def java_string_hashcode(s: str) -> int:
     """Java ``String.hashCode``: h = 31*h + c over UTF-16 code units,
     wrapping in 32-bit signed arithmetic.
 
     Characters outside the BMP (emoji — common in tweets) contribute their
-    two surrogate code units, exactly as on the JVM.
+    two surrogate code units, exactly as on the JVM; lone surrogates (which
+    arise from unit-level bigram windows, see ``char_bigrams``) are accepted.
     """
     h = 0
-    for unit_lo, unit_hi in zip(
-        *[iter(s.encode("utf-16-le"))] * 2
-    ):  # little-endian 16-bit code units
-        cu = unit_lo | (unit_hi << 8)
+    for cu in utf16_units(s):
         h = (31 * h + cu) & 0xFFFFFFFF
     if h >= 0x80000000:
         h -= 0x100000000
@@ -42,13 +46,20 @@ def non_negative_mod(x: int, mod: int) -> int:
 
 
 def char_bigrams(text: str) -> list[str]:
-    """Scala ``text.sliding(2)``: consecutive 2-char windows; a string shorter
-    than 2 yields itself as the single (short) window, empty yields nothing."""
-    if len(text) == 0:
+    """Scala ``text.sliding(2)``: consecutive 2-char windows over the JVM's
+    chars, i.e. UTF-16 CODE UNITS — an astral character (emoji) is two chars
+    on the JVM, so its surrogate halves land in separate windows. A string
+    shorter than 2 units yields itself as the single window, empty yields
+    nothing. Returned strings may contain lone surrogates (valid Python str;
+    hashing handles them via surrogatepass)."""
+    units = utf16_units(text)
+    if not units:
         return []
-    if len(text) < 2:
+    if len(units) < 2:
         return [text]
-    return [text[i : i + 2] for i in range(len(text) - 1)]
+    return [
+        chr(units[i]) + chr(units[i + 1]) for i in range(len(units) - 1)
+    ]
 
 
 def hashing_tf_counts(terms: Iterable[str], num_features: int) -> dict[int, float]:

@@ -1,0 +1,115 @@
+"""ctypes bridge to the C++ fast featurizer (native/fasthash.cpp).
+
+Builds the shared library on first use (g++ is in the image; no network or
+pybind11 required), loads it via ctypes, and exposes ``fasthash_batch``
+filling padded numpy buffers in place. Falls back silently when a compiler
+isn't available — features/hashing.py stays the semantic ground truth and
+the parity test asserts the two implementations agree bigram-for-bigram.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from ..utils import get_logger
+
+log = get_logger("features.native")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "fasthash.cpp")
+_LIB = os.path.join(_REPO_ROOT, "native", "libfasthash.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except Exception as exc:
+        log.warning("native featurizer build failed (%s); using python path", exc)
+        return False
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+        ):
+            if not os.path.exists(_SRC) or not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+            lib.fasthash_batch.restype = ctypes.c_int32
+            lib.fasthash_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_uint16),  # units
+                ctypes.POINTER(ctypes.c_int64),  # offsets
+                ctypes.c_int32,  # batch
+                ctypes.c_int32,  # num_features
+                ctypes.c_int32,  # l_max
+                ctypes.POINTER(ctypes.c_int32),  # out_idx
+                ctypes.POINTER(ctypes.c_float),  # out_val
+                ctypes.POINTER(ctypes.c_int32),  # out_ntok
+            ]
+            _lib = lib
+        except OSError as exc:
+            log.warning("native featurizer load failed (%s)", exc)
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def hash_texts(
+    texts: list[str],
+    num_features: int,
+    out_idx: np.ndarray,
+    out_val: np.ndarray,
+) -> np.ndarray | None:
+    """Hash lowercased texts into the caller's padded [B, L] buffers.
+    Returns per-row distinct-term counts, or None if the native path is
+    unavailable or L was too small (caller should re-bucket or fall back)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    b, l_max = out_idx.shape
+    assert len(texts) <= b
+    encoded = [t.encode("utf-16-le") for t in texts]
+    offsets = np.zeros(len(texts) + 1, dtype=np.int64)
+    np.cumsum([len(e) // 2 for e in encoded], out=offsets[1:])
+    units = np.frombuffer(b"".join(encoded), dtype=np.uint16)
+    if units.size == 0:
+        units = np.zeros(1, dtype=np.uint16)
+    ntok = np.zeros(b, dtype=np.int32)
+
+    max_terms = lib.fasthash_batch(
+        units.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(texts),
+        num_features,
+        l_max,
+        out_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out_val.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ntok.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if max_terms > l_max or (ntok[: len(texts)] < 0).any():
+        # token bucket too small, or a row overflowed the C scratch table
+        return None
+    return ntok

@@ -137,6 +137,11 @@ class StreamingContext:
                 break
         self._terminated.set()
 
+    def request_stop(self) -> None:
+        """Ask the scheduler to stop after the current batch — the public
+        early-exit hook apps use for max-batches caps."""
+        self._stop.set()
+
     # -- lifecycle (ssc.start/awaitTermination, LinearRegression.scala:89-91) --
     def start(self) -> None:
         if self._stream is None:
