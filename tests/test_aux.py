@@ -1,0 +1,136 @@
+"""Aux subsystems: checkpoint/resume, fault injection, tracing hooks — the
+upgrades SURVEY.md §5 calls out as absent in the reference."""
+
+import os
+
+import numpy as np
+import pytest
+
+from twtml_tpu.checkpoint import Checkpointer
+from twtml_tpu.config import ConfArguments
+from twtml_tpu.features.featurizer import Status
+from twtml_tpu.streaming.faults import FaultInjectingSource
+from twtml_tpu.streaming.sources import SyntheticSource
+from twtml_tpu.utils.tracing import Tracer
+
+DATA = os.path.join(os.path.dirname(__file__), "data", "tweets.jsonl")
+
+
+class TestCheckpointer:
+    def test_save_restore_roundtrip(self, tmp_path):
+        ckpt = Checkpointer(str(tmp_path))
+        w = np.arange(10, dtype=np.float32)
+        ckpt.save(5, w, {"count": 123})
+        restored, meta = ckpt.restore()
+        np.testing.assert_array_equal(restored, w)
+        assert meta["count"] == 123 and meta["step"] == 5
+
+    def test_pytree_weights(self, tmp_path):
+        ckpt = Checkpointer(str(tmp_path))
+        ckpt.save(1, {"text": np.ones(4), "num": np.zeros(2)})
+        restored, _ = ckpt.restore()
+        assert set(restored) == {"text", "num"}
+        np.testing.assert_array_equal(restored["text"], np.ones(4))
+
+    def test_keep_last_prunes(self, tmp_path):
+        ckpt = Checkpointer(str(tmp_path), keep_last=2)
+        for step in range(5):
+            ckpt.save(step, np.array([float(step)]))
+        assert ckpt.latest_step() == 4
+        files = [n for n in os.listdir(tmp_path) if n.endswith(".npz")]
+        assert len(files) == 2
+        restored, meta = ckpt.restore()
+        assert meta["step"] == 4
+
+    def test_corrupt_latest_falls_back(self, tmp_path):
+        ckpt = Checkpointer(str(tmp_path))
+        ckpt.save(1, np.array([1.0]))
+        ckpt.save(2, np.array([2.0]))
+        # corrupt the newest file
+        newest = sorted(tmp_path.glob("ckpt-*.npz"))[-1]
+        newest.write_bytes(b"garbage")
+        restored, meta = ckpt.restore()
+        assert meta["step"] == 1
+        np.testing.assert_array_equal(restored, [1.0])
+
+    def test_restore_empty_dir(self, tmp_path):
+        assert Checkpointer(str(tmp_path)).restore() is None
+
+
+class TestFaultInjection:
+    def test_crash_every_n_and_recovery(self):
+        import time
+
+        inner = SyntheticSource(total=50, seed=1)
+        src = FaultInjectingSource(inner, crash_every=20, max_crashes=2)
+        got = []
+        src.start(got.append)
+        deadline = time.time() + 10
+        while not src.exhausted and time.time() < deadline:
+            time.sleep(0.01)
+        src.stop()
+        assert src.exhausted, "stream must complete after bounded crashes"
+        assert src.crashes == 2  # crashed at 20 and 40, restarted both times
+        assert len(got) >= 50  # all tweets eventually delivered (some dup'd
+        # on restart since the synthetic stream restarts its generator)
+
+    def test_finite_replay_with_faults_completes(self):
+        """Regression: deterministic crashing must not livelock a finite
+        replay file (crash cap lets the last run reach EOF)."""
+        import time
+
+        from twtml_tpu.streaming.sources import ReplayFileSource
+
+        src = FaultInjectingSource(
+            ReplayFileSource(DATA), crash_every=4, max_crashes=3
+        )
+        got = []
+        src.start(got.append)
+        deadline = time.time() + 10
+        while not src.exhausted and time.time() < deadline:
+            time.sleep(0.01)
+        src.stop()
+        assert src.exhausted
+        assert src.crashes == 3
+        assert len(got) >= 10  # full file delivered on the clean final run
+
+
+class TestAppResume:
+    def test_linear_app_checkpoints_and_resumes(self, tmp_path, capsys):
+        from twtml_tpu.apps.linear_regression import run
+
+        def conf():
+            return ConfArguments().parse([
+                "--source", "replay", "--replayFile", DATA,
+                "--seconds", "1", "--backend", "cpu",
+                "--checkpointDir", str(tmp_path), "--checkpointEvery", "1",
+                "--lightning", "http://127.0.0.1:9",
+                "--twtweb", "http://127.0.0.1:9",
+            ])
+
+        first = run(conf())
+        assert first["count"] == 6
+        ckpt = Checkpointer(str(tmp_path))
+        weights_after_first, meta = ckpt.restore()
+        assert meta["count"] == 6
+        assert np.abs(weights_after_first).sum() > 0
+
+        # second run resumes: cumulative count continues from 6
+        second = run(conf())
+        assert second["count"] == 12
+        out = capsys.readouterr().out
+        assert "count: 12" in out
+
+
+class TestTracer:
+    def test_disabled_tracer_is_noop(self):
+        with Tracer("") as t:
+            assert not t.enabled
+
+    def test_enabled_tracer_writes_trace(self, tmp_path):
+        import jax.numpy as jnp
+
+        with Tracer(str(tmp_path)):
+            (jnp.arange(8.0) * 2).block_until_ready()
+        produced = list(tmp_path.rglob("*"))
+        assert produced, "no trace files written"

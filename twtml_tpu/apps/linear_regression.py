@@ -51,14 +51,26 @@ def build_source(conf) -> Source:
     if conf.source == "replay":
         if not conf.replayFile:
             raise SystemExit("--source replay requires --replayFile <path.jsonl>")
-        return ReplayFileSource(conf.replayFile, speed=conf.replaySpeed)
-    if conf.source == "synthetic":
-        return SyntheticSource(rate=conf.replaySpeed or 0.0)
-    if conf.source == "twitter":
+        source: Source = ReplayFileSource(conf.replayFile, speed=conf.replaySpeed)
+    elif conf.source == "synthetic":
+        source = SyntheticSource(rate=conf.replaySpeed or 0.0)
+    elif conf.source == "twitter":
         from ..streaming.twitter import TwitterSource
 
-        return TwitterSource.from_properties()
-    raise SystemExit(f"unknown --source {conf.source!r}")
+        source = TwitterSource.from_properties()
+    else:
+        raise SystemExit(f"unknown --source {conf.source!r}")
+    if conf.faultEvery > 0:
+        from ..streaming.faults import FaultInjectingSource
+
+        # finite replay files need the crash cap to avoid livelock (each
+        # restart re-reads from the start); unbounded sources keep crashing
+        source = FaultInjectingSource(
+            source,
+            crash_every=conf.faultEvery,
+            max_crashes=3 if conf.source == "replay" else 0,
+        )
+    return source
 
 
 def run(conf: ConfArguments, max_batches: int = 0) -> dict:
@@ -77,6 +89,28 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     )
 
     totals = {"count": 0, "batches": 0}
+
+    # checkpoint/resume (upgrade over the reference, SURVEY.md §5.4)
+    ckpt = None
+    if conf.checkpointDir:
+        from ..checkpoint import Checkpointer
+
+        ckpt = Checkpointer(conf.checkpointDir)
+        restored = ckpt.restore()
+        if restored is not None:
+            weights, meta = restored
+            model.set_initial_weights(weights)
+            totals["count"] = int(meta.get("count", 0))
+            totals["batches"] = int(meta.get("batches", 0))
+            log.info(
+                "resumed from checkpoint step %s (count=%s)",
+                meta.get("step"), totals["count"],
+            )
+
+    from ..utils.tracing import Tracer
+
+    tracer = Tracer(conf.profileDir)
+    last_saved = {"step": totals["batches"]}
 
     def on_batch(batch, _batch_time) -> None:
         if batch.num_valid == 0:
@@ -101,12 +135,21 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         session.update(
             totals["count"], b, mse, real_stdev, pred_stdev, real, pred
         )
+        if ckpt is not None and conf.checkpointEvery > 0 and (
+            totals["batches"] % conf.checkpointEvery == 0
+        ):
+            ckpt.save(
+                totals["batches"], model.latest_weights,
+                {"count": totals["count"], "batches": totals["batches"]},
+            )
+            last_saved["step"] = totals["batches"]
         if max_batches and totals["batches"] >= max_batches:
             ssc.request_stop()
 
     stream.foreach_batch(on_batch)
 
     log.info("Starting the streaming computation...")
+    tracer.start()
     ssc.start()
     try:
         ssc.await_termination()
@@ -114,6 +157,12 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         pass
     finally:
         ssc.stop()
+        tracer.stop()
+        if ckpt is not None and totals["batches"] != last_saved["step"]:
+            ckpt.save(
+                totals["batches"], model.latest_weights,
+                {"count": totals["count"], "batches": totals["batches"]},
+            )
     return totals
 
 

@@ -1,0 +1,113 @@
+"""Model checkpoint/resume — an upgrade the reference lacks.
+
+The reference never checkpoints model weights: a restarted job begins from
+zeros (LinearRegression.scala:32; SURVEY.md §5.4 flags this as the gap —
+only the web server's Config JSON survives restarts). Here the full learner
+state (weight pytree + cumulative counters + batch index) is saved every N
+batches and restored on start, so a crashed/restarted streaming job resumes
+its RMSE curve instead of relearning from scratch.
+
+Format: one .npz per checkpoint (atomic rename), flat key namespace for the
+weight pytree, JSON sidecar metadata inside the archive. keep_last bounds
+disk use. Works for single-device and mesh-sharded states (arrays are pulled
+to host; on restore the model re-shards via its own set_initial_weights).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import tempfile
+
+import numpy as np
+
+from ..utils import get_logger
+
+log = get_logger("checkpoint")
+
+
+class Checkpointer:
+    def __init__(self, directory: str, keep_last: int = 3):
+        self.directory = directory
+        self.keep_last = keep_last
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"ckpt-{step:012d}.npz")
+
+    def save(self, step: int, weights, metadata: dict | None = None) -> str:
+        """Atomically write weights (array or flat dict of arrays) + metadata
+        at the given step; prunes old checkpoints beyond keep_last."""
+        arrays: dict[str, np.ndarray] = {}
+        if isinstance(weights, dict):
+            for key, value in weights.items():
+                arrays[f"w__{key}"] = np.asarray(value)
+        else:
+            arrays["w"] = np.asarray(weights)
+        meta = dict(metadata or {})
+        meta["step"] = int(step)
+        buf = io.BytesIO()
+        np.savez(buf, __meta__=np.frombuffer(
+            json.dumps(meta).encode("utf-8"), dtype=np.uint8), **arrays)
+        final = self._path(step)
+        fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                fh.write(buf.getvalue())
+            os.replace(tmp, final)  # atomic on POSIX
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        self._prune()
+        log.info("checkpoint saved: %s", final)
+        return final
+
+    def _checkpoints(self) -> list[str]:
+        try:
+            names = [
+                n for n in os.listdir(self.directory)
+                if n.startswith("ckpt-") and n.endswith(".npz")
+            ]
+        except FileNotFoundError:
+            return []
+        return sorted(names)
+
+    def _prune(self) -> None:
+        names = self._checkpoints()
+        for name in names[: -self.keep_last]:
+            try:
+                os.unlink(os.path.join(self.directory, name))
+            except OSError:
+                pass
+
+    def latest_step(self) -> int | None:
+        names = self._checkpoints()
+        if not names:
+            return None
+        return int(names[-1][len("ckpt-") : -len(".npz")])
+
+    def restore(self, step: int | None = None):
+        """(weights, metadata) of the given/latest checkpoint, or None.
+        Corrupt newest checkpoints fall back to older ones (crash-during-
+        write tolerance beyond the atomic rename)."""
+        names = self._checkpoints()
+        if step is not None:
+            names = [n for n in names if n == os.path.basename(self._path(step))]
+        for name in reversed(names):
+            path = os.path.join(self.directory, name)
+            try:
+                with np.load(path) as data:
+                    meta = json.loads(bytes(data["__meta__"]).decode("utf-8"))
+                    keys = [k for k in data.files if k != "__meta__"]
+                    if keys == ["w"]:
+                        weights = data["w"]
+                    else:
+                        weights = {
+                            k[len("w__"):]: data[k] for k in keys
+                        }
+                return weights, meta
+            except Exception:
+                log.warning("unreadable checkpoint %s; trying older", path)
+        return None

@@ -121,6 +121,7 @@ class ConfArguments:
         self.checkpointDir: str = conf.get("checkpointDir", "")
         self.checkpointEvery: int = int(conf.get("checkpointEvery", "0"))
         self.profileDir: str = conf.get("profileDir", "")
+        self.faultEvery: int = int(conf.get("faultEvery", "0"))
 
         # Spark-compat knobs: --master/--name are accepted for CLI parity
         # (ConfArguments.scala:95-102); master is interpreted as a backend
@@ -179,6 +180,7 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
   --checkpointDir <path>                       Enable model checkpoint/resume
   --checkpointEvery <int batches>              Checkpoint cadence. Default: {self.checkpointEvery}
   --profileDir <path>                          Enable jax.profiler traces
+  --faultEvery <int tweets>                    Inject a receiver crash every N tweets (chaos testing)
 """
 
     def parse(self, args: list[str]) -> "ConfArguments":
@@ -244,6 +246,8 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
             self.checkpointEvery = int(take())
         elif flag == "--profileDir":
             self.profileDir = take()
+        elif flag == "--faultEvery":
+            self.faultEvery = int(take())
         elif flag in ("--help", "-h"):
             self.printUsage(0)
         else:

@@ -1,0 +1,87 @@
+"""Fault injection for stream sources (SURVEY.md §5.3: the reference has no
+fault injection anywhere; receiver recovery was whatever Spark defaulted to).
+
+``FaultInjectingSource`` wraps any Source and raises a simulated receiver
+crash every ``crash_every`` tweets (deterministic) or with probability
+``crash_prob`` per tweet (seeded) — exercising the supervision/restart/backoff
+harness end-to-end in tests and chaos runs. Emitted tweets are passed through
+unchanged; a crash loses the in-flight iterator exactly like a dropped
+socket, so delivery gaps behave like the real failure mode.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterator
+
+from ..features.featurizer import Status
+from ..utils import get_logger
+from .sources import Source
+
+log = get_logger("streaming.faults")
+
+
+class InjectedFault(ConnectionError):
+    pass
+
+
+class FaultInjectingSource(Source):
+    name = "fault-injecting"
+
+    def __init__(
+        self,
+        inner: Source,
+        crash_every: int = 0,
+        crash_prob: float = 0.0,
+        max_crashes: int = 3,
+        seed: int = 0,
+        **kw,
+    ):
+        kw.setdefault("max_restarts", 1_000_000)  # chaos runs should survive
+        kw.setdefault("restart_backoff", 0.01)
+        super().__init__(**kw)
+        self.inner = inner
+        self.crash_every = crash_every
+        self.crash_prob = crash_prob
+        # crashes are capped so finite sources (replay files) still complete:
+        # each restart re-runs inner.produce() from scratch, so unbounded
+        # deterministic crashing would livelock any file shorter than
+        # crash_every × restarts. max_crashes<=0 means unbounded (only
+        # sensible for unbounded sources).
+        self.max_crashes = max_crashes
+        self._rng = random.Random(seed)
+        self._emitted = 0
+        self.crashes = 0
+
+    def _may_crash(self) -> bool:
+        return self.max_crashes <= 0 or self.crashes < self.max_crashes
+
+    def produce(self) -> Iterator[Status]:
+        for status in self.inner.produce():
+            if (
+                self.crash_every
+                and self._emitted
+                and self._emitted % self.crash_every == 0
+                and self._may_crash()
+            ):
+                self._emitted += 1
+                self.crashes += 1
+                raise InjectedFault(
+                    f"injected receiver crash #{self.crashes} "
+                    f"after {self._emitted - 1} tweets"
+                )
+            if (
+                self.crash_prob
+                and self._may_crash()
+                and self._rng.random() < self.crash_prob
+            ):
+                self.crashes += 1
+                raise InjectedFault(f"injected probabilistic crash #{self.crashes}")
+            self._emitted += 1
+            yield status
+
+    def stop(self) -> None:
+        # unblock the inner source first: our producer thread may be parked
+        # in the inner's paced _stop.wait(), which only inner.stop() releases
+        self.inner.stop()
+        super().stop()

@@ -74,7 +74,11 @@ class Source:
                     log.exception("source %s died permanently", self.name)
                     self._exhausted.set()
                     return
-                backoff = self.restart_backoff * (2 ** (restarts - 1))
+                # cap the exponent too: restarts can reach the millions in
+                # unbounded chaos runs and 2**n overflows float conversion
+                backoff = min(
+                    self.restart_backoff * (2 ** min(restarts - 1, 12)), 30.0
+                )
                 log.exception(
                     "source %s crashed; restart %d/%d in %.1fs",
                     self.name, restarts, self.max_restarts, backoff,

@@ -1,0 +1,62 @@
+"""Profiling/tracing hooks (SURVEY.md §5.1: absent in the reference — Spark's
+UI was the de-facto profiler; here jax.profiler is first-class).
+
+``Tracer(profile_dir)`` wraps jax.profiler.start_trace/stop_trace with a
+no-op mode when disabled, so apps can call it unconditionally:
+
+    tracer = Tracer(conf.profileDir)
+    tracer.start()
+    ... training ...
+    tracer.stop()
+
+Traces are TensorBoard-compatible (xplane) under ``profile_dir``; on TPU they
+include device timelines and XLA op breakdowns.
+"""
+
+from __future__ import annotations
+
+from . import get_logger
+
+log = get_logger("tracing")
+
+
+class Tracer:
+    def __init__(self, profile_dir: str = ""):
+        self.profile_dir = profile_dir
+        self._active = False
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.profile_dir)
+
+    def start(self) -> None:
+        if not self.enabled or self._active:
+            return
+        import jax
+
+        jax.profiler.start_trace(self.profile_dir)
+        self._active = True
+        log.info("jax.profiler trace started → %s", self.profile_dir)
+
+    def stop(self) -> None:
+        if not self._active:
+            return
+        import jax
+
+        jax.profiler.stop_trace()
+        self._active = False
+        log.info("jax.profiler trace written → %s", self.profile_dir)
+
+    def __enter__(self) -> "Tracer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def annotate(name: str):
+    """Named region visible in trace timelines (TraceAnnotation)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
