@@ -1,0 +1,174 @@
+"""Pallas TPU kernel: the fused dense streaming-SGD hot loop.
+
+The per-batch compute core (SURVEY.md §3.3 — numIterations of
+predict→gradient→update on a [B, F] design matrix) runs as ONE pallas program
+with the design matrix resident in VMEM for the entire loop: X is loaded from
+HBM once, then all ``num_iterations`` MXU matvecs (forward ``X·w`` and
+gradient ``r·X``) and VPU vector updates hit on-chip memory only. The
+XLA-built fallback re-streams X from HBM every iteration; this kernel removes
+that traffic for models in the dense regime (the reference's 1004-dim model
+padded to 1024 lanes: 2048×1024 f32 = 8 MB, comfortably inside ~16 MB VMEM).
+
+Semantics match models/sgd.py's ``sgd_inner_loop`` for the configuration the
+kernel supports (mini_batch_fraction == 1.0, least-squares residual): same
+1-indexed stepSize/√i schedule, L2 pre-scale, zero-count skip, convergence
+tolerance with converged-freeze. The builder gates itself on those knobs and
+returns None otherwise, so callers fall back transparently.
+
+Layout notes (guide: /opt/skills/guides/pallas_guide.md):
+- all refs are ≥2D and VMEM-resident; B and F must be multiples of (8, 128);
+- matvecs keep the MXU busy via dot_general with
+  ``preferred_element_type=f32``; w lives as [F, 1];
+- the iteration loop is a ``lax.fori_loop`` inside the kernel (sequential on
+  one core — exactly the dependency chain SGD imposes anyway).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _sgd_kernel(
+    x_ref, y_ref, mask_ref, w0_ref, wout_ref, preds_ref,
+    *, num_iterations: int, step_size: float, l2_reg: float,
+    convergence_tol: float,
+):
+    X = x_ref[:]  # [B, F] — stays in VMEM across the whole loop
+    y = y_ref[:]  # [B, 1]
+    m = mask_ref[:]  # [B, 1]
+    w0 = w0_ref[:]  # [F, 1]
+
+    def matvec(w):
+        return jax.lax.dot_general(
+            X, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [B, 1]
+
+    def grad_sum(residual):
+        return jax.lax.dot_general(
+            X, residual, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [F, 1]
+
+    # predictions with pre-update weights (predict-then-train)
+    preds_ref[:] = matvec(w0)
+
+    count = jnp.sum(m)
+    denom = jnp.maximum(count, 1.0)
+
+    def body(i, carry):
+        w, converged = carry
+        it = i + 1
+        residual = (matvec(w) - y) * m
+        grad = grad_sum(residual) / denom
+        eta = step_size / jnp.sqrt(jnp.float32(it))
+        w_new = w * (1.0 - eta * l2_reg) - eta * grad
+        w_new = jnp.where(count > 0, w_new, w)
+        if convergence_tol > 0:
+            delta = jnp.sqrt(jnp.sum((w_new - w) ** 2))
+            norm_new = jnp.sqrt(jnp.sum(w_new * w_new))
+            conv_now = (count > 0) & (
+                delta < convergence_tol * jnp.maximum(norm_new, 1.0)
+            )
+        else:
+            conv_now = False
+        w_out = jnp.where(converged, w, w_new)
+        return w_out, jnp.logical_or(converged, conv_now)
+
+    w_final, _ = lax.fori_loop(
+        0, num_iterations, body, (w0, jnp.array(False))
+    )
+    wout_ref[:] = w_final
+
+
+# VMEM budget: X + copies of w/preds must fit in ~16MB/core with headroom.
+VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def padded_lanes(num_features: int) -> int:
+    """The kernel's own padding rule — single source of truth for callers."""
+    return -(-num_features // 128) * 128
+
+
+def supports(
+    *, batch_rows: int, num_features: int, mini_batch_fraction: float, dtype
+) -> bool:
+    f_padded = padded_lanes(num_features)
+    backend = jax.default_backend()
+    return (
+        backend in ("tpu", "cpu")  # cpu runs the interpreter; others can't lower
+        and mini_batch_fraction >= 1.0
+        and dtype == jnp.float32
+        and batch_rows % 8 == 0
+        and batch_rows * f_padded * 4 <= VMEM_BUDGET_BYTES
+    )
+
+
+@functools.cache
+def _build(batch_rows, f_padded, num_iterations, step_size, l2_reg,
+           convergence_tol, interpret):
+    kernel = functools.partial(
+        _sgd_kernel,
+        num_iterations=num_iterations,
+        step_size=step_size,
+        l2_reg=l2_reg,
+        convergence_tol=convergence_tol,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((f_padded, 1), jnp.float32),  # weights
+            jax.ShapeDtypeStruct((batch_rows, 1), jnp.float32),  # raw preds
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # X
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # y
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # mask
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # w0
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )
+
+
+def fused_dense_sgd(
+    x_dense,
+    labels,
+    mask,
+    weights,
+    *,
+    num_iterations: int,
+    step_size: float,
+    l2_reg: float = 0.0,
+    convergence_tol: float = 0.001,
+    interpret: bool | None = None,
+):
+    """Run the fused loop on a dense [B, F] batch. ``weights`` is the flat
+    [F] vector; F is padded to a lane multiple internally. Returns
+    (new_weights [F], raw_predictions [B])."""
+    b, f = x_dense.shape
+    f_padded = padded_lanes(f)
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    if f_padded != f:
+        x_dense = jnp.pad(x_dense, ((0, 0), (0, f_padded - f)))
+        weights = jnp.pad(weights, (0, f_padded - f))
+    call = _build(
+        b, f_padded, num_iterations, float(step_size), float(l2_reg),
+        float(convergence_tol), bool(interpret),
+    )
+    w_out, preds = call(
+        x_dense.astype(jnp.float32),
+        labels.astype(jnp.float32)[:, None],
+        mask.astype(jnp.float32)[:, None],
+        weights.astype(jnp.float32)[:, None],
+    )
+    return w_out[:f, 0], preds[:, 0]
