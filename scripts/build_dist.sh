@@ -1,0 +1,12 @@
+#!/bin/sh
+# Distribution zip (equivalent of the reference's build.sh assembly zip:
+# build.sh:6-16 bundles the spark jar + web jar; here one zip carries the
+# python package, the native featurizer source, and the dashboard assets).
+set -e
+version="0.1.0"
+cd "$(dirname "$0")/.."
+rm -rf target && mkdir -p target
+zip -qr "target/twtml-tpu-${version}.zip" \
+    twtml_tpu native pyproject.toml README.md bench.py \
+    -x "*/__pycache__/*" -x "*.so"
+echo "target/twtml-tpu-${version}.zip"

@@ -177,6 +177,48 @@ class SyntheticSource(Source):
                 return
 
 
+class MultiSource(Source):
+    """Sharded receiver fan-in: run N inner sources concurrently into one
+    stream. The reference is hard-wired to a single Twitter4j receiver
+    (SURVEY.md §2.4.4 "receiver parallelism = 1"); this is the single-host
+    version of the N-way sharded stream in BASELINE config #5 (multi-host
+    sharding lives in parallel/distributed.py)."""
+
+    name = "multi"
+
+    def __init__(self, sources: list[Source], **kw):
+        super().__init__(**kw)
+        self.sources = sources
+
+    def start(self, emit) -> None:
+        self._emit = emit
+        self._stop.clear()
+        self._exhausted.clear()
+        for src in self.sources:
+            src.start(emit)
+        # watcher thread flips exhausted when every shard is done
+        self._thread = threading.Thread(
+            target=self._watch, name="twtml-source-multi", daemon=True
+        )
+        self._thread.start()
+
+    def _watch(self) -> None:
+        while not self._stop.is_set():
+            if all(s.exhausted for s in self.sources):
+                self._exhausted.set()
+                return
+            if self._stop.wait(0.05):
+                return
+
+    def stop(self) -> None:
+        for src in self.sources:
+            src.stop()
+        super().stop()
+
+    def produce(self):  # pragma: no cover - inner sources produce directly
+        return iter(())
+
+
 class QueueSource(Source):
     """Test source: push Status objects from the test thread."""
 
