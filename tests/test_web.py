@@ -117,6 +117,30 @@ def test_websocket_broadcast_and_connect_push(server):
     assert client.get_stats().count == 7
 
 
+def test_series_roundtrip_and_window(server):
+    """Additive Series messages: cached in a rolling window, served at
+    /api/series for chart backfill, broadcast like everything else."""
+    _, url, cache = server
+    client = WebClient(url)
+    for k in range(3):
+        client.series([float(k), k + 0.5], [k + 1.0, k + 1.5], 10.0, 12.0)
+    import urllib.request
+
+    with urllib.request.urlopen(url + "/api/series", timeout=2) as resp:
+        items = json.loads(resp.read())
+    assert len(items) == 3
+    assert items[0]["jsonClass"] == "Series"
+    assert items[-1]["real"] == [2.0, 2.5]
+    assert items[-1]["realStddev"] == 10.0
+    # rolling window bounded
+    from twtml_tpu.web.cache import SERIES_WINDOW
+
+    for k in range(SERIES_WINDOW + 10):
+        client.series([1.0], [1.0], 0.0, 0.0)
+    with urllib.request.urlopen(url + "/api/series", timeout=2) as resp:
+        assert len(json.loads(resp.read())) == SERIES_WINDOW
+
+
 def test_http_post_broadcasts_to_websockets(server):
     _, url, _ = server
     ws_url = url.replace("http://", "ws://") + "/api"

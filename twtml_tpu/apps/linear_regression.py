@@ -34,14 +34,17 @@ def select_backend(conf) -> None:
     ConfArguments.scala:54-56)."""
     import jax
 
+    shards = conf.local_shards()
+    if shards:
+        # honor the local[N] hint before any backend initialization; it only
+        # affects the CPU platform, so it's harmless when TPU wins auto
+        try:
+            jax.config.update("jax_num_cpu_devices", shards)
+        except RuntimeError:
+            log.warning("backend already initialized; local[%d] hint dropped", shards)
     if conf.backend == "cpu":
         jax.config.update("jax_platforms", "cpu")
-        shards = conf.local_shards()
-        if shards:
-            jax.config.update("jax_num_cpu_devices", shards)
     elif conf.backend == "tpu":
-        import jax
-
         kinds = {d.platform for d in jax.devices()}
         if "cpu" in kinds and len(kinds) == 1:
             raise RuntimeError("--backend tpu requested but only CPU devices present")
@@ -73,6 +76,25 @@ def build_source(conf) -> Source:
     return source
 
 
+def build_model(conf):
+    """Single-device fused learner on one chip; mesh-sharded learner when the
+    backend exposes several devices (or local[N] caps a virtual CPU mesh) —
+    the CLI face of BASELINE config #5's data-parallel scale-up. Returns
+    (model, required row multiple for batches)."""
+    import jax
+
+    shards = conf.local_shards()
+    n_devices = len(jax.devices())
+    n_data = min(shards, n_devices) if shards else n_devices
+    if n_data > 1:
+        from ..parallel import ParallelSGDModel, make_mesh
+
+        mesh = make_mesh(num_data=n_data, devices=jax.devices()[:n_data])
+        log.info("mesh-sharded training: %d-way data parallel", n_data)
+        return ParallelSGDModel.from_conf(conf, mesh), n_data
+    return StreamingLinearRegressionWithSGD.from_conf(conf), 1
+
+
 def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     log.info("Initializing session stats...")
     session = SessionStats(conf).open()
@@ -80,12 +102,13 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     log.info("Initializing TPU-native streaming model...")
     select_backend(conf)
     featurizer = Featurizer.from_conf(conf)
-    model = StreamingLinearRegressionWithSGD.from_conf(conf)
+    model, row_multiple = build_model(conf)
 
     log.info("Initializing streaming context... %s sec/batch", conf.seconds)
     ssc = StreamingContext(batch_interval=conf.seconds)
     stream = ssc.source_stream(
-        build_source(conf), featurizer, row_bucket=conf.batchBucket
+        build_source(conf), featurizer,
+        row_bucket=conf.batchBucket, row_multiple=row_multiple,
     )
 
     totals = {"count": 0, "batches": 0}

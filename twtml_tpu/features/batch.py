@@ -49,10 +49,21 @@ def _bucket(n: int, minimum: int = 8) -> int:
     return b
 
 
+def pad_row_count(n: int, row_bucket: int, row_multiple: int = 1) -> int:
+    """Padded row count: the requested bucket when it fits, else the
+    power-of-two bucket — then rounded up to ``row_multiple`` (mesh data-axis
+    divisibility for shard_map training)."""
+    b = row_bucket if row_bucket >= n and row_bucket > 0 else _bucket(max(n, 1))
+    if row_multiple > 1:
+        b += (-b) % row_multiple
+    return b
+
+
 def pad_feature_batch(
     rows: list[tuple[dict[int, float], np.ndarray, float]],
     row_bucket: int = 0,
     token_bucket: int = 0,
+    row_multiple: int = 1,
 ) -> FeatureBatch:
     """Assemble per-tweet sparse features into one padded FeatureBatch.
 
@@ -62,7 +73,7 @@ def pad_feature_batch(
     """
     n = len(rows)
     max_tok = max((len(r[0]) for r in rows), default=1)
-    b = row_bucket if row_bucket >= n and row_bucket > 0 else _bucket(max(n, 1))
+    b = pad_row_count(n, row_bucket, row_multiple)
     lt = token_bucket if token_bucket >= max_tok and token_bucket > 0 else _bucket(
         max(max_tok, 1)
     )

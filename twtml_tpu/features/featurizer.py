@@ -175,6 +175,7 @@ class Featurizer:
         row_bucket: int = 0,
         token_bucket: int = 0,
         pre_filtered: bool = False,
+        row_multiple: int = 1,
     ) -> FeatureBatch:
         """Filter + featurize + pad a micro-batch of tweets.
 
@@ -183,17 +184,21 @@ class Featurizer:
         columns are assembled vectorized — the Python per-tweet path remains
         as semantic ground truth and fallback."""
         keep = statuses if pre_filtered else [s for s in statuses if self.filtrate(s)]
-        fast = self._featurize_batch_native(keep, row_bucket, token_bucket)
+        fast = self._featurize_batch_native(keep, row_bucket, token_bucket, row_multiple)
         if fast is not None:
             return fast
         rows = [self.featurize(s) for s in keep]
-        return pad_feature_batch(rows, row_bucket=row_bucket, token_bucket=token_bucket)
+        return pad_feature_batch(
+            rows, row_bucket=row_bucket, token_bucket=token_bucket,
+            row_multiple=row_multiple,
+        )
 
     def _featurize_batch_native(
-        self, keep: list[Status], row_bucket: int, token_bucket: int
+        self, keep: list[Status], row_bucket: int, token_bucket: int,
+        row_multiple: int = 1,
     ) -> FeatureBatch | None:
         from . import native
-        from .batch import _bucket
+        from .batch import _bucket, pad_row_count
 
         if self.normalize_accents or self.label_fn is not None:
             return None  # python path handles the uncommon configurations
@@ -209,7 +214,7 @@ class Featurizer:
         max_tok = max(
             (max(len(t.encode("utf-16-le")) // 2 - 1, 1) for t in texts), default=1
         )
-        b = row_bucket if row_bucket >= n and row_bucket > 0 else _bucket(max(n, 1))
+        b = pad_row_count(n, row_bucket, row_multiple)
         lt = (
             token_bucket
             if token_bucket >= max_tok and token_bucket > 0

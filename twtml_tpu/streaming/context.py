@@ -59,15 +59,23 @@ class FeatureStream(RawStream):
     """A RawStream whose outputs receive padded FeatureBatches instead of
     Status lists (DStream.map(featurize) analog)."""
 
-    def __init__(self, featurizer: Featurizer, row_bucket: int = 0, token_bucket: int = 0):
+    def __init__(
+        self,
+        featurizer: Featurizer,
+        row_bucket: int = 0,
+        token_bucket: int = 0,
+        row_multiple: int = 1,
+    ):
         super().__init__()
         self.featurizer = featurizer
         self.row_bucket = row_bucket
         self.token_bucket = token_bucket
+        self.row_multiple = row_multiple
 
     def _process(self, statuses: list[Status], batch_time: float) -> FeatureBatch:
         batch = self.featurizer.featurize_batch(
-            statuses, row_bucket=self.row_bucket, token_bucket=self.token_bucket
+            statuses, row_bucket=self.row_bucket, token_bucket=self.token_bucket,
+            row_multiple=self.row_multiple,
         )
         for fn in self._outputs:
             fn(batch, batch_time)
@@ -91,6 +99,7 @@ class StreamingContext:
         featurizer: Featurizer,
         row_bucket: int = 0,
         token_bucket: int = 0,
+        row_multiple: int = 1,
     ) -> FeatureStream:
         """Attach the (single) source and build its feature stream —
         equivalent of TwitterUtils.createStream().filter().map().cache()
@@ -98,7 +107,7 @@ class StreamingContext:
         if self._source is not None:
             raise ValueError("StreamingContext supports one source stream")
         self._source = source
-        self._stream = FeatureStream(featurizer, row_bucket, token_bucket)
+        self._stream = FeatureStream(featurizer, row_bucket, token_bucket, row_multiple)
         return self._stream
 
     def raw_stream(self, source: Source) -> RawStream:

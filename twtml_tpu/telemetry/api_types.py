@@ -36,7 +36,23 @@ class Stats:
     json_class = "Stats"
 
 
-TYPES = {"Config": Config, "Stats": Stats}
+@dataclass
+class Series:
+    """Per-batch real/predicted value series — an ADDITIVE message type (no
+    reference equivalent; the reference ships these points to the external
+    Lightning server only, SessionStats.scala:31-33). Carried on the same
+    jsonClass-discriminated wire so legacy dashboards simply ignore it; the
+    built-in dashboard renders it as the live chart."""
+
+    real: list[float] = field(default_factory=list)
+    pred: list[float] = field(default_factory=list)
+    realStddev: float = 0.0
+    predStddev: float = 0.0
+
+    json_class = "Series"
+
+
+TYPES = {"Config": Config, "Stats": Stats, "Series": Series}
 
 
 def encode(obj: Config | Stats) -> str:

@@ -17,6 +17,9 @@ from .web_client import WebClient
 
 log = get_logger("telemetry.session")
 
+# per-batch cap on chart series points shipped to the dashboard
+SERIES_MAX_POINTS = 200
+
 # SessionStats.scala:15-20
 REAL_COLOR_DET = [173.0, 216.0, 230.0]  # light blue
 REAL_COLOR = [30.0, 144.0, 255.0]  # blue
@@ -71,10 +74,24 @@ class SessionStats:
         """Push one batch of stats — same call shape as SessionStats.update
         (SessionStats.scala:22-34); mse/stdevs arrive already HALF_UP-rounded
         and are truncated to int for the dashboard like ``.toLong``."""
+        stats_ok = True
         try:
             self.web.stats(count, batch, int(mse), int(real_stdev), int(pred_stdev))
         except Exception:
+            stats_ok = False
             log.debug("web.stats failed", exc_info=True)
+        if stats_ok:
+            # feed the built-in dashboard chart (Lightning-free path); the
+            # chart window keeps ~400 points, so huge bench-scale batches are
+            # subsampled before paying the JSON encode on the hot path
+            try:
+                self.web.series(
+                    list(real[:SERIES_MAX_POINTS]),
+                    list(pred[:SERIES_MAX_POINTS]),
+                    real_stdev, pred_stdev,
+                )
+            except Exception:
+                log.debug("web.series failed", exc_info=True)
         if self.viz is not None:
             try:
                 real_stdev_arr = [real_stdev] * int(batch)

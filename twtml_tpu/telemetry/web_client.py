@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import urllib.request
 
-from .api_types import Config, Stats, decode, encode
+from .api_types import Config, Series, Stats, decode, encode
 
 DEFAULT_SERVER = "http://localhost:8888"  # WebClient.scala:13
 
@@ -48,6 +48,20 @@ class WebClient:
                 mse=int(mse),
                 realStddev=int(real_stddev),
                 predStddev=int(pred_stddev),
+            )
+        )
+
+    def series(
+        self, real, pred, real_stddev: float, pred_stddev: float
+    ) -> None:
+        """Push one batch's real/pred series for the built-in live chart
+        (additive message; no reference equivalent — Lightning held these)."""
+        self._post(
+            Series(
+                real=[float(v) for v in real],
+                pred=[float(v) for v in pred],
+                realStddev=float(real_stddev),
+                predStddev=float(pred_stddev),
             )
         )
 

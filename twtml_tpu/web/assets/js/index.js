@@ -4,9 +4,13 @@
   "use strict";
 
   const ids = ["count", "batch", "mse", "realStddev", "predStddev"];
+  let chart = null;
+  let backfilled = false;
+  const pendingSeries = [];
 
   function onConfig(json) {
     for (const id of ids) document.getElementById(id).textContent = "0";
+    if (chart) chart.clear();
     document.getElementById("session").textContent = json.id || "—";
     const graphs = document.getElementById("graphs");
     graphs.replaceChildren();
@@ -30,6 +34,11 @@
     switch (json.jsonClass) {
       case "Config": onConfig(json); break;
       case "Stats": onStats(json); break;
+      case "Series":
+        // live frames buffer until the history backfill lands (ordering)
+        if (!backfilled) pendingSeries.push(json);
+        else if (chart) chart.push(json);
+        break;
       case "_Socket": {
         const badge = document.getElementById("conn");
         badge.textContent = json.open ? "live" : "offline";
@@ -40,8 +49,20 @@
   }
 
   document.addEventListener("DOMContentLoaded", () => {
+    chart = new LiveChart(document.getElementById("livechart"));
+    chart.draw();
     api.bind(onMessage);
     api.websocketOn();
     api.getStats().then(onStats).catch(() => {});
+    // backfill the chart from the server's rolling series window, then
+    // apply any live frames that arrived while the fetch was in flight
+    const flush = () => {
+      backfilled = true;
+      for (const s of pendingSeries.splice(0)) chart.push(s);
+    };
+    fetch("/api/series").then((r) => r.json()).then((items) => {
+      for (const s of items) chart.push(s);
+      flush();
+    }).catch(flush);
   });
 })();

@@ -13,12 +13,18 @@ from __future__ import annotations
 import os
 import tempfile
 
-from ..telemetry.api_types import Config, Stats, decode, encode
+import collections
+import json as _json
+
+from ..telemetry.api_types import Config, Series, Stats, decode, encode
 from ..utils import get_logger
 
 log = get_logger("web.cache")
 
 BACKUP_FILE = os.path.join(tempfile.gettempdir(), "twtml-web.json")
+
+# rolling chart history: enough for a few minutes of batches on a dashboard
+SERIES_WINDOW = 64
 
 
 class ApiCache:
@@ -26,12 +32,24 @@ class ApiCache:
         self.backup_file = backup_file
         self._stats = Stats()
         self._config = Config()
+        self._series: collections.deque[Series] = collections.deque(
+            maxlen=SERIES_WINDOW
+        )
 
     def config(self) -> str:
         return encode(self._config)
 
     def stats(self) -> str:
         return encode(self._stats)
+
+    def series(self) -> str:
+        """Recent Series messages as a JSON array (chart backfill for
+        dashboards that connect mid-run; in-memory only, like Stats)."""
+        from dataclasses import asdict
+
+        return _json.dumps(
+            [{"jsonClass": s.json_class, **asdict(s)} for s in self._series]
+        )
 
     def cache(self, json_text: str) -> None:
         """Dispatch on the jsonClass hint (ApiCache.scala:41-48); unknown
@@ -46,6 +64,8 @@ class ApiCache:
         if isinstance(data, Stats):
             log.debug("caching stats")
             self._stats = data
+        elif isinstance(data, Series):
+            self._series.append(data)
         else:
             log.debug("caching config")
             self._config = data
