@@ -1,0 +1,93 @@
+"""RMSE-vs-wall-clock measurement — the BASELINE.md metric the reference
+only ever displayed live on a dashboard ("streaming RMSE vs wall-clock",
+BASELINE.md:11; the reference computes per-batch MSE at
+LinearRegression.scala:65 but never records a curve).
+
+Runs the flagship streaming pipeline on a replayed or synthetic stream and
+emits one JSON line per batch: elapsed wall-clock seconds, cumulative tweet
+count, per-batch RMSE (progressive validation — each batch scored with
+pre-update weights). Curves from different backends/configs are directly
+comparable ("identical RMSE curves" is the north-star acceptance criterion,
+BASELINE.json).
+
+Usage:
+  python tools/rmse_curve.py --source synthetic --tweets 100000 \
+      [--batch 2048] [--backend cpu] [--out curve.jsonl] [usual twtml flags]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from twtml_tpu.config import ConfArguments  # noqa: E402
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    tweets, batch_size, out_path = 50_000, 2048, ""
+    rest: list[str] = []
+    it = iter(range(len(args)))
+    i = 0
+    while i < len(args):
+        if args[i] == "--tweets":
+            tweets = int(args[i + 1]); i += 2
+        elif args[i] == "--batch":
+            batch_size = int(args[i + 1]); i += 2
+        elif args[i] == "--out":
+            out_path = args[i + 1]; i += 2
+        else:
+            rest.append(args[i]); i += 1
+
+    conf = ConfArguments().setAppName("rmse-curve").parse(rest)
+
+    from twtml_tpu.apps.linear_regression import build_model, select_backend
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.streaming.sources import ReplayFileSource, SyntheticSource
+
+    select_backend(conf)
+    featurizer = Featurizer.from_conf(conf)
+    model, row_multiple = build_model(conf)
+
+    if conf.source == "replay":
+        if not conf.replayFile:
+            raise SystemExit("--source replay requires --replayFile")
+        statuses = [
+            s for s in ReplayFileSource(conf.replayFile).produce()
+            if featurizer.filtrate(s)
+        ]
+        pre_filtered = True
+    else:
+        statuses = list(SyntheticSource(total=tweets, seed=7).produce())
+        pre_filtered = True
+
+    sink = open(out_path, "w", encoding="utf-8") if out_path else sys.stdout
+    count = 0
+    t0 = time.perf_counter()
+    for k in range(0, len(statuses), batch_size):
+        chunk = statuses[k : k + batch_size]
+        batch = featurizer.featurize_batch(
+            chunk, row_bucket=batch_size, pre_filtered=pre_filtered,
+            row_multiple=row_multiple,
+        )
+        if batch.num_valid == 0:
+            continue
+        out = model.step(batch)
+        count += int(out.count)
+        record = {
+            "wall_s": round(time.perf_counter() - t0, 4),
+            "count": count,
+            "batch": int(out.count),
+            "rmse": round(float(out.mse) ** 0.5, 3),
+        }
+        print(json.dumps(record), file=sink, flush=sink is sys.stdout)
+    if sink is not sys.stdout:
+        sink.close()
+
+
+if __name__ == "__main__":
+    main()

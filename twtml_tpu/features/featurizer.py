@@ -58,7 +58,7 @@ def _parse_created_at_ms(value: Any) -> int:
             return 0
 
 
-@dataclass
+@dataclass(slots=True)
 class Status:
     """Minimal tweet model covering the Twitter4j Status surface the
     reference reads (getRetweetedStatus/getText/getUser/getCreatedAt/
@@ -207,13 +207,13 @@ class Featurizer:
         n = len(keep)
         originals = [s.retweeted_status for s in keep]
         texts = [o.text.lower() for o in originals]
+        encoded = native.encode_texts(texts)
         # distinct bigrams per tweet can't exceed its UTF-16 unit count − 1
         # (bigrams window over code units, like the JVM — astral chars count
         # twice), so this token bucket only needs a retry in the pathological
         # >1024-distinct-terms case where the C side signals fallback
-        max_tok = max(
-            (max(len(t.encode("utf-16-le")) // 2 - 1, 1) for t in texts), default=1
-        )
+        lengths = np.diff(encoded[1])
+        max_tok = int(np.maximum(lengths - 1, 1).max()) if n else 1
         b = pad_row_count(n, row_bucket, row_multiple)
         lt = (
             token_bucket
@@ -222,7 +222,9 @@ class Featurizer:
         )
         token_idx = np.zeros((b, lt), dtype=np.int32)
         token_val = np.zeros((b, lt), dtype=np.float32)
-        ntok = native.hash_texts(texts, self.num_text_features, token_idx, token_val)
+        ntok = native.hash_texts(
+            texts, self.num_text_features, token_idx, token_val, encoded=encoded
+        )
         if ntok is None:
             return None
 
@@ -231,18 +233,22 @@ class Featurizer:
         label = np.zeros((b,), dtype=np.float32)
         mask = np.zeros((b,), dtype=np.float32)
         if n:
-            numeric[:n, 0] = np.fromiter(
-                (o.followers_count for o in originals), np.float64, n
-            ) * 1e-12
-            numeric[:n, 1] = np.fromiter(
-                (o.favourites_count for o in originals), np.float64, n
-            ) * 1e-12
-            numeric[:n, 2] = np.fromiter(
-                (o.friends_count for o in originals), np.float64, n
-            ) * 1e-12
-            numeric[:n, 3] = (
-                now - np.fromiter((o.created_at_ms for o in originals), np.float64, n)
-            ) * 1e-14
-            label[:n] = np.fromiter((o.retweet_count for o in originals), np.float64, n)
+            # one pass over the objects; columns scaled vectorized
+            raw = np.array(
+                [
+                    (
+                        o.followers_count,
+                        o.favourites_count,
+                        o.friends_count,
+                        o.created_at_ms,
+                        o.retweet_count,
+                    )
+                    for o in originals
+                ],
+                dtype=np.float64,
+            )
+            numeric[:n, :3] = raw[:, :3] * 1e-12
+            numeric[:n, 3] = (now - raw[:, 3]) * 1e-14
+            label[:n] = raw[:, 4]
             mask[:n] = 1.0
         return FeatureBatch(token_idx, token_val, numeric, label, mask)

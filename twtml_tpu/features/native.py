@@ -77,26 +77,36 @@ def available() -> bool:
     return get_lib() is not None
 
 
+def encode_texts(texts: list[str]) -> tuple[np.ndarray, np.ndarray]:
+    """One-pass UTF-16-LE encode of a batch: (units, offsets). Callers reuse
+    the offsets for token-bucket sizing so texts are encoded exactly once."""
+    encoded = [t.encode("utf-16-le") for t in texts]
+    offsets = np.zeros(len(texts) + 1, dtype=np.int64)
+    np.cumsum([len(e) >> 1 for e in encoded], out=offsets[1:])
+    units = np.frombuffer(b"".join(encoded), dtype=np.uint16)
+    if units.size == 0:
+        units = np.zeros(1, dtype=np.uint16)
+    return units, offsets
+
+
 def hash_texts(
     texts: list[str],
     num_features: int,
     out_idx: np.ndarray,
     out_val: np.ndarray,
+    encoded: tuple[np.ndarray, np.ndarray] | None = None,
 ) -> np.ndarray | None:
     """Hash lowercased texts into the caller's padded [B, L] buffers.
     Returns per-row distinct-term counts, or None if the native path is
-    unavailable or L was too small (caller should re-bucket or fall back)."""
+    unavailable or L was too small (caller should re-bucket or fall back).
+    ``encoded``: optional pre-computed (units, offsets) from encode_texts."""
     lib = get_lib()
     if lib is None:
         return None
     b, l_max = out_idx.shape
     assert len(texts) <= b
-    encoded = [t.encode("utf-16-le") for t in texts]
-    offsets = np.zeros(len(texts) + 1, dtype=np.int64)
-    np.cumsum([len(e) // 2 for e in encoded], out=offsets[1:])
-    units = np.frombuffer(b"".join(encoded), dtype=np.uint16)
-    if units.size == 0:
-        units = np.zeros(1, dtype=np.uint16)
+    units, offsets = encoded if encoded is not None else encode_texts(texts)
+    assert offsets.size == len(texts) + 1, "encoded does not match texts"
     ntok = np.zeros(b, dtype=np.int32)
 
     max_terms = lib.fasthash_batch(
