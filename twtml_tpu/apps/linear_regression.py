@@ -34,15 +34,22 @@ def select_backend(conf) -> None:
     ConfArguments.scala:54-56)."""
     import jax
 
+    from ..utils import set_cpu_device_count_hint
+
     shards = conf.local_shards()
     if shards:
         # honor the local[N] hint before any backend initialization; it only
         # affects the CPU platform, so it's harmless when TPU wins auto
-        try:
-            jax.config.update("jax_num_cpu_devices", shards)
-        except RuntimeError:
+        if not set_cpu_device_count_hint(shards):
             log.warning("backend already initialized; local[%d] hint dropped", shards)
     if conf.backend == "cpu":
+        from ..utils.backend import backends_initialized
+
+        if backends_initialized() and jax.default_backend() != "cpu":
+            raise RuntimeError(
+                "--backend cpu requested but a non-cpu backend is already "
+                "initialized in this process"
+            )
         jax.config.update("jax_platforms", "cpu")
     elif conf.backend == "tpu":
         kinds = {d.platform for d in jax.devices()}

@@ -1,4 +1,10 @@
 from .rounding import round_half_up
 from .logging import get_logger
+from .backend import force_virtual_cpu_devices, set_cpu_device_count_hint
 
-__all__ = ["round_half_up", "get_logger"]
+__all__ = [
+    "round_half_up",
+    "get_logger",
+    "force_virtual_cpu_devices",
+    "set_cpu_device_count_hint",
+]

@@ -1,0 +1,66 @@
+"""Backend/platform selection helpers.
+
+One place for the "force an n-device virtual CPU mesh" dance used by the
+driver entry (``__graft_entry__.dryrun_multichip``) and the CLI backend
+selector (``apps.linear_regression.select_backend``): both need to set
+``jax_num_cpu_devices`` *before* any backend initialization and degrade
+gracefully when one is already live. tests/conftest.py deliberately does not
+import this (it must configure jax before the repo is even on sys.path), but
+follows the same recipe.
+"""
+
+from __future__ import annotations
+
+
+def backends_initialized() -> bool | None:
+    """True/False when jax can report whether a backend is initialized in
+    this process (after which device-count configs can no longer change);
+    None when the probe (a jax-internal symbol, no stability guarantee) is
+    unavailable — callers then fall back to public-API behavior: attempt the
+    config update and catch the RuntimeError jax raises post-init."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge.backends_are_initialized())
+    except Exception:
+        return None
+
+
+def force_virtual_cpu_devices(n_devices: int) -> bool:
+    """Switch jax to an ``n_devices``-device virtual CPU backend.
+
+    The virtual CPU mesh compiles and executes the same
+    Mesh/shard_map/psum program structure the TPU path uses, which is how
+    multi-chip sharding is validated on hosts without n real chips.
+
+    Returns True when the configuration was applied; False when a backend was
+    already initialized (the config is then left untouched and the caller
+    should use whatever devices exist).
+    """
+    import jax
+
+    if backends_initialized():
+        return False
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    except RuntimeError:
+        # probe was unavailable and a backend with a different CPU device
+        # count is already live
+        return False
+
+
+def set_cpu_device_count_hint(n_devices: int) -> bool:
+    """Set the CPU device count without forcing the platform (the local[N]
+    hint: only affects runs where the CPU backend wins platform selection).
+    Returns False if a backend is already initialized, leaving it untouched."""
+    import jax
+
+    if backends_initialized():
+        return False
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+        return True
+    except RuntimeError:
+        return False
