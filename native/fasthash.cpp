@@ -9,12 +9,15 @@
 // two units exactly like the JVM, matching MllibHelper.scala:42-56 /
 // MLlib HashingTF.
 //
-// Build: g++ -O3 -shared -fPIC -o libfasthash.so fasthash.cpp
+// Build: g++ -O3 -shared -fPIC -pthread -o libfasthash.so fasthash.cpp
 // Loaded via ctypes (twtml_tpu/features/native.py); pure-Python fallback
 // remains authoritative for parity tests.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 namespace {
 
@@ -50,13 +53,16 @@ extern "C" {
 //                 l_max; caller re-buckets and retries in that case)
 //
 // Returns the maximum distinct-term count seen (for bucket sizing).
-int32_t fasthash_batch(const uint16_t* units, const int64_t* offsets,
-                       int32_t batch, int32_t num_features, int32_t l_max,
-                       int32_t* out_idx, float* out_val, int32_t* out_ntok) {
+static int32_t fasthash_rows(const uint16_t* units, const int64_t* offsets,
+                             int32_t row_begin, int32_t row_end,
+                             int32_t num_features, int32_t l_max,
+                             int32_t* out_idx, float* out_val,
+                             int32_t* out_ntok) {
   Slot table[kTableSize];
+  for (int32_t i = 0; i < kTableSize; ++i) table[i].idx = -1;
   int32_t max_terms = 0;
 
-  for (int32_t b = 0; b < batch; ++b) {
+  for (int32_t b = row_begin; b < row_end; ++b) {
     const int64_t start = offsets[b];
     const int64_t end = offsets[b + 1];
     const int64_t len = end - start;
@@ -92,8 +98,6 @@ int32_t fasthash_batch(const uint16_t* units, const int64_t* offsets,
       }
     };
 
-    for (int32_t i = 0; i < kTableSize; ++i) table[i].idx = -1;
-
     if (len == 1) {
       // sliding(2) on a 1-unit string yields the string itself
       add_term(static_cast<int32_t>(units[start]));
@@ -111,6 +115,7 @@ int32_t fasthash_batch(const uint16_t* units, const int64_t* offsets,
       // >kTableSize distinct terms in one tweet: unambiguous sentinel so the
       // Python caller falls back to the exact path
       out_ntok[b] = -1;
+      for (int32_t j = 0; j < n_used; ++j) table[used[j]].idx = -1;
       continue;
     }
     out_ntok[b] = n_used;
@@ -123,6 +128,50 @@ int32_t fasthash_batch(const uint16_t* units, const int64_t* offsets,
       row_idx[j] = s.idx;
       row_val[j] = s.count;
     }
+    // reset only the touched slots for the next row (the full table is
+    // cleared once per thread above)
+    for (int32_t j = 0; j < n_used; ++j) table[used[j]].idx = -1;
+  }
+  return max_terms;
+}
+
+// Featurize one micro-batch, row-parallel across up to n_threads OS threads
+// (rows are independent; each thread owns a contiguous row range and its own
+// scratch table). n_threads <= 0 means auto (hardware concurrency, capped).
+// The ctypes caller releases the GIL for the duration of this call.
+int32_t fasthash_batch(const uint16_t* units, const int64_t* offsets,
+                       int32_t batch, int32_t num_features, int32_t l_max,
+                       int32_t* out_idx, float* out_val, int32_t* out_ntok,
+                       int32_t n_threads) {
+  constexpr int32_t kMinRowsPerThread = 256;
+  if (n_threads <= 0) {
+    const unsigned hw = std::thread::hardware_concurrency();
+    n_threads = static_cast<int32_t>(hw ? std::min(hw, 8u) : 1u);
+  }
+  n_threads = std::max(
+      1, std::min(n_threads, batch / kMinRowsPerThread));
+
+  if (n_threads == 1) {
+    return fasthash_rows(units, offsets, 0, batch, num_features, l_max,
+                         out_idx, out_val, out_ntok);
+  }
+
+  std::vector<int32_t> maxes(n_threads, 0);
+  std::vector<std::thread> workers;
+  workers.reserve(n_threads);
+  const int32_t rows_per = (batch + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    const int32_t b0 = t * rows_per;
+    const int32_t b1 = std::min(batch, b0 + rows_per);
+    workers.emplace_back([=, &maxes] {
+      maxes[t] = fasthash_rows(units, offsets, b0, b1, num_features, l_max,
+                               out_idx, out_val, out_ntok);
+    });
+  }
+  int32_t max_terms = 0;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    workers[t].join();
+    max_terms = std::max(max_terms, maxes[t]);
   }
   return max_terms;
 }

@@ -96,3 +96,31 @@ def test_over_1024_distinct_terms_falls_back_not_hangs():
     batch = feat.featurize_batch([s], pre_filtered=True)
     assert batch.num_valid == 1
     assert int((batch.token_val[0] > 0).sum()) == 1199
+
+
+def test_multithreaded_path_matches_python(monkeypatch):
+    """Exercise the row-parallel C path (n_threads>1 needs >=512 rows to
+    clear the per-thread row minimum) against the Python ground truth —
+    partitioning, per-thread scratch tables, and slot resets included.
+    Mixes empty, single-char, emoji, and long rows across the partitions."""
+    monkeypatch.setenv("TWTML_NATIVE_THREADS", "4")
+    texts = ["", "a", "😀", "héllo 😀🚀 wörld", "the quick brown fox", "ab" * 120]
+    keep = [
+        Status(retweeted_status=Status(text=texts[i % len(texts)] + str(i), retweet_count=500))
+        for i in range(1024)
+    ]
+    feat = Featurizer(now_ms=0)
+    fast = feat._featurize_batch_native(keep, 0, 0)
+    assert fast is not None
+    from twtml_tpu.features.batch import pad_feature_batch
+
+    slow = pad_feature_batch([feat.featurize(s) for s in keep])
+    assert rows_as_dicts(fast)[: len(keep)] == rows_as_dicts(slow)[: len(keep)]
+
+
+def test_thread_env_non_integer_falls_back_to_auto(monkeypatch):
+    monkeypatch.setenv("TWTML_NATIVE_THREADS", "auto")
+    feat = Featurizer(now_ms=0)
+    s = Status(retweeted_status=Status(text="hello world", retweet_count=500))
+    batch = feat.featurize_batch([s], pre_filtered=True)  # must not raise
+    assert batch.num_valid == 1

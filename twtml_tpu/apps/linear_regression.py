@@ -43,14 +43,15 @@ def select_backend(conf) -> None:
         if not set_cpu_device_count_hint(shards):
             log.warning("backend already initialized; local[%d] hint dropped", shards)
     if conf.backend == "cpu":
-        from ..utils.backend import backends_initialized
-
-        if backends_initialized() and jax.default_backend() != "cpu":
+        # jax_platforms silently no-ops when a backend is already live, so
+        # verify the outcome instead of guessing the pre-state (and this
+        # first jax.default_backend() call initializes cpu when it did work)
+        jax.config.update("jax_platforms", "cpu")
+        if jax.default_backend() != "cpu":
             raise RuntimeError(
                 "--backend cpu requested but a non-cpu backend is already "
                 "initialized in this process"
             )
-        jax.config.update("jax_platforms", "cpu")
     elif conf.backend == "tpu":
         kinds = {d.platform for d in jax.devices()}
         if "cpu" in kinds and len(kinds) == 1:

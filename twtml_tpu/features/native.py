@@ -32,7 +32,7 @@ _tried = False
 def _build() -> bool:
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-o", _LIB, _SRC],
             check=True, capture_output=True, timeout=120,
         )
         return True
@@ -66,6 +66,7 @@ def get_lib() -> ctypes.CDLL | None:
                 ctypes.POINTER(ctypes.c_int32),  # out_idx
                 ctypes.POINTER(ctypes.c_float),  # out_val
                 ctypes.POINTER(ctypes.c_int32),  # out_ntok
+                ctypes.c_int32,  # n_threads (<=0 = auto)
             ]
             _lib = lib
         except OSError as exc:
@@ -75,6 +76,16 @@ def get_lib() -> ctypes.CDLL | None:
 
 def available() -> bool:
     return get_lib() is not None
+
+
+def _thread_count_from_env() -> int:
+    """TWTML_NATIVE_THREADS: <=0 or unset/non-integer = auto (the C side
+    picks hardware concurrency, capped, scaled down for small batches)."""
+    try:
+        return int(os.environ.get("TWTML_NATIVE_THREADS", "0"))
+    except ValueError:
+        log.warning("TWTML_NATIVE_THREADS is not an integer; using auto")
+        return 0
 
 
 def encode_texts(texts: list[str]) -> tuple[np.ndarray, np.ndarray]:
@@ -118,6 +129,7 @@ def hash_texts(
         out_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         out_val.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         ntok.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        _thread_count_from_env(),
     )
     if max_terms > l_max or (ntok[: len(texts)] < 0).any():
         # token bucket too small, or a row overflowed the C scratch table

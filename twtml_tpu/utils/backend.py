@@ -16,8 +16,11 @@ def backends_initialized() -> bool | None:
     """True/False when jax can report whether a backend is initialized in
     this process (after which device-count configs can no longer change);
     None when the probe (a jax-internal symbol, no stability guarantee) is
-    unavailable — callers then fall back to public-API behavior: attempt the
-    config update and catch the RuntimeError jax raises post-init."""
+    unavailable — the helpers below then fall back to public-API behavior:
+    attempt the ``jax_num_cpu_devices`` update first and catch the
+    RuntimeError jax raises for it post-init (``jax_platforms`` never
+    raises, so callers that only flip the platform must verify the outcome
+    via ``jax.default_backend()`` instead)."""
     try:
         from jax._src import xla_bridge
 
