@@ -19,7 +19,6 @@ import json
 import os
 import subprocess
 import sys
-import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
@@ -40,36 +39,16 @@ def measure(n_tweets: int = N_TWEETS, batch_size: int = BATCH) -> dict:
     feat = Featurizer(now_ms=1785320000000)
     model = StreamingLinearRegressionWithSGD()
 
-    # warmup/compile on the first buckets
-    warm = feat.featurize_batch(statuses[:batch_size], row_bucket=batch_size)
-    for _ in range(WARMUP_BATCHES):
-        model.step(warm)
-
-    # double-buffered pipeline: featurize chunk k+1 on a host thread while
-    # the device runs chunk k (SURVEY.md §7 hard part (c))
-    from concurrent.futures import ThreadPoolExecutor
+    from twtml_tpu.utils.benchloop import measure_pipeline
 
     chunks = [statuses[i : i + batch_size] for i in range(0, n_tweets, batch_size)]
 
     def featurize(chunk):
         return feat.featurize_batch(chunk, row_bucket=batch_size, pre_filtered=True)
 
-    t0 = time.perf_counter()
-    last = None
-    with ThreadPoolExecutor(max_workers=1) as pool:
-        pending = pool.submit(featurize, chunks[0])
-        for nxt in chunks[1:]:
-            batch = pending.result()
-            pending = pool.submit(featurize, nxt)
-            last = model.step(batch)
-        last = model.step(pending.result())
-    last.mse.block_until_ready()
-    dt = time.perf_counter() - t0
-    return {
-        "tweets_per_sec": n_tweets / dt,
-        "seconds": dt,
-        "final_mse": float(last.mse),
-    }
+    out = measure_pipeline(model, featurize, chunks, warmup_steps=WARMUP_BATCHES)
+    del out["batches"]
+    return out
 
 
 def main() -> None:

@@ -1,0 +1,37 @@
+"""The BASELINE-config benchmark suite must measure every config (tiny
+sizes here; the numbers are irrelevant, the plumbing is what's tested)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools"))
+
+import bench_suite
+
+
+def test_replay_linear_measures():
+    rec = bench_suite.run_config("replay_linear", 512, 256)
+    assert rec["tweets_per_sec"] > 0 and rec["batches"] == 2
+    assert rec["backend"] == "cpu"
+
+
+def test_logistic_sentiment_measures():
+    rec = bench_suite.run_config("logistic_sentiment", 512, 256)
+    assert rec["tweets_per_sec"] > 0
+    assert 0.0 <= rec["final_metric"] <= 1.0  # misclassification rate
+
+
+def test_hashing_2e18_l2_uses_sparse_path():
+    rec = bench_suite.run_config("hashing_2e18_l2", 512, 256)
+    assert rec["tweets_per_sec"] > 0
+
+
+def test_sharded_dp4_runs_on_virtual_mesh():
+    # conftest provides 8 virtual CPU devices
+    rec = bench_suite.run_config("sharded_dp4", 512, 256)
+    assert rec["tweets_per_sec"] > 0
+
+
+def test_twitter_live_skips_without_creds():
+    rec = bench_suite.run_config("twitter_live", 64, 64)
+    assert "skipped" in rec

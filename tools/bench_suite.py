@@ -1,0 +1,223 @@
+"""Benchmark suite — one measurement per BASELINE.md target config.
+
+BASELINE.md lists five configs to measure (the reference publishes no
+numbers, so every baseline is measured, not copied):
+
+  1. replay_linear     — streaming linear regression on a replayed
+                         (deterministic synthetic) tweet stream
+  2. twitter_live      — same on the live Twitter stream (needs OAuth creds
+                         + network; reported as skipped when absent)
+  3. logistic_sentiment— streaming logistic regression, lexicon sentiment
+                         labels (BASELINE config #3)
+  4. hashing_2e18_l2   — 2^18-dim HashingTF featurizer + L2-regularized SGD,
+                         the sparse gather/scatter path (config #4)
+  5. sharded_dp4       — 4-way data-parallel mesh, per-shard stream +
+                         in-program psum gradient reduce (config #5; virtual
+                         CPU mesh when <4 real chips are attached)
+
+Each config runs in its own subprocess (clean jax backend state) and prints
+one JSON line: {"config", "tweets_per_sec", "seconds", "batches", "final_metric",
+"backend", "skipped"?}. The headline single-number benchmark stays bench.py.
+
+Usage: python tools/bench_suite.py [--tweets N] [--batch B] [--json out.jsonl]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CONFIGS = [
+    "replay_linear",
+    "twitter_live",
+    "logistic_sentiment",
+    "hashing_2e18_l2",
+    "sharded_dp4",
+]
+
+
+def _pipeline_rate(model, feat, statuses, batch_size, row_multiple=1, shard=None):
+    """The shared double-buffered pipeline (utils/benchloop.py), with the
+    suite's per-config featurizer/shard hooks."""
+    from twtml_tpu.utils.benchloop import measure_pipeline
+
+    chunks = [statuses[i : i + batch_size] for i in range(0, len(statuses), batch_size)]
+
+    def featurize(chunk):
+        b = feat.featurize_batch(
+            chunk, row_bucket=batch_size, pre_filtered=True,
+            row_multiple=row_multiple,
+        )
+        return shard(b) if shard else b
+
+    out = measure_pipeline(model, featurize, chunks)
+    return {
+        "tweets_per_sec": round(out["tweets_per_sec"], 1),
+        "seconds": round(out["seconds"], 3),
+        "batches": out["batches"],
+        "final_metric": round(out["final_mse"], 3),
+    }
+
+
+def run_config(name: str, n_tweets: int, batch_size: int) -> dict:
+    import jax
+
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.streaming.sources import SyntheticSource
+
+    out: dict = {"config": name}
+
+    if name == "twitter_live":
+        from twtml_tpu.config import ConfArguments, get_property
+
+        conf = ConfArguments().parse(["--source", "twitter"])
+        creds = [
+            get_property("twitter4j.oauth." + k)
+            for k in ("consumerKey", "consumerSecret", "accessToken", "accessTokenSecret")
+        ]
+        if not all(creds):
+            return {**out, "skipped": "no Twitter OAuth credentials configured"}
+        # Live measurement: run the real app for ~6 batches and report its
+        # observed ingest rate (rate is bounded by the stream, not compute).
+        from twtml_tpu.apps import linear_regression as app
+
+        t0 = time.perf_counter()
+        totals = app.run(conf, max_batches=6)
+        dt = time.perf_counter() - t0
+        return {
+            **out,
+            "tweets_per_sec": round(totals["count"] / dt, 1),
+            "seconds": round(dt, 3),
+            "batches": totals["batches"],
+            "backend": jax.default_backend(),
+        }
+
+    statuses = list(SyntheticSource(total=n_tweets, seed=3).produce())
+
+    if name == "replay_linear":
+        from twtml_tpu.models import StreamingLinearRegressionWithSGD
+
+        feat = Featurizer(now_ms=1785320000000)
+        model = StreamingLinearRegressionWithSGD()
+        out.update(_pipeline_rate(model, feat, statuses, batch_size))
+    elif name == "logistic_sentiment":
+        from twtml_tpu.features.sentiment import sentiment_label
+        from twtml_tpu.models import StreamingLogisticRegressionWithSGD
+
+        feat = Featurizer(now_ms=1785320000000)
+        feat.label_fn = sentiment_label
+        model = StreamingLogisticRegressionWithSGD()
+        out.update(_pipeline_rate(model, feat, statuses, batch_size))
+    elif name == "hashing_2e18_l2":
+        from twtml_tpu.models import StreamingLinearRegressionWithSGD
+
+        feat = Featurizer(num_text_features=2**18, now_ms=1785320000000)
+        model = StreamingLinearRegressionWithSGD(
+            num_text_features=2**18, l2_reg=0.1
+        )
+        out.update(_pipeline_rate(model, feat, statuses, batch_size))
+    elif name == "sharded_dp4":
+        from twtml_tpu.parallel import ParallelSGDModel, make_mesh
+        from twtml_tpu.parallel.sharding import shard_batch
+
+        if len(jax.devices()) < 4:
+            return {**out, "skipped": "backend initialized with <4 devices"}
+        mesh = make_mesh(num_data=4, devices=jax.devices()[:4])
+        model = ParallelSGDModel(mesh)
+        out.update(
+            _pipeline_rate(
+                model, Featurizer(now_ms=1785320000000), statuses, batch_size,
+                row_multiple=4, shard=lambda b: shard_batch(b, mesh),
+            )
+        )
+    else:
+        raise SystemExit(f"unknown config {name!r}")
+
+    out["backend"] = jax.default_backend()
+    return out
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    n_tweets, batch_size, out_path, child = 8192, 2048, "", ""
+    i = 0
+    while i < len(args):
+        if args[i] == "--tweets":
+            n_tweets = int(args[i + 1]); i += 2
+        elif args[i] == "--batch":
+            batch_size = int(args[i + 1]); i += 2
+        elif args[i] == "--json":
+            out_path = args[i + 1]; i += 2
+        elif args[i] == "--config":
+            child = args[i + 1]; i += 2
+        else:
+            raise SystemExit(f"unknown flag {args[i]!r}")
+
+    force_cpu = bool(os.environ.get("TWTML_BENCH_CPU"))
+
+    if child:
+        if child == "sharded_dp4" and (
+            force_cpu or int(os.environ.get("TWTML_REAL_DEVICES", "1")) < 4
+        ):
+            # parent saw <4 real chips (or CPU was requested): run the mesh
+            # on 4 virtual CPU devices — must happen before this process
+            # initializes any backend
+            from twtml_tpu.utils import force_virtual_cpu_devices
+
+            force_virtual_cpu_devices(4)
+        elif force_cpu:
+            from twtml_tpu.utils import force_virtual_cpu_devices
+
+            force_virtual_cpu_devices(1)
+        print(json.dumps(run_config(child, n_tweets, batch_size)))
+        return
+
+    if force_cpu:
+        # TWTML_BENCH_CPU=1: measure everything host-side (no accelerator
+        # init at all — also the escape hatch when the TPU tunnel is down)
+        n_real = 0
+    else:
+        # count real devices in a throwaway subprocess: accelerators are
+        # exclusive per process, so the parent must never initialize one
+        # while children need it
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+                capture_output=True, text=True, timeout=300,
+            )
+            n_real = int(probe.stdout.strip().splitlines()[-1])
+        except Exception:
+            n_real = 0
+    env = dict(os.environ, TWTML_REAL_DEVICES=str(n_real))
+
+    lines = []
+    for name in CONFIGS:
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--config", name,
+                 "--tweets", str(n_tweets), "--batch", str(batch_size)],
+                env=env, capture_output=True, text=True, timeout=1800,
+            )
+            rec = json.loads(proc.stdout.strip().splitlines()[-1])
+        except subprocess.TimeoutExpired:
+            rec = {"config": name, "error": "timeout (1800s)"}
+        except Exception:
+            rec = {
+                "config": name,
+                "error": (proc.stderr or proc.stdout).strip()[-400:],
+            }
+        lines.append(rec)
+        print(json.dumps(rec), flush=True)
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as fh:
+            fh.write("\n".join(json.dumps(r) for r in lines) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Shared measurement loop for the benchmarks (bench.py, tools/bench_suite.py).
+
+The double-buffered pipeline under test: featurize chunk k+1 on a host
+thread while the device runs chunk k (SURVEY.md §7 hard part (c) — hiding
+host featurization latency behind device steps).
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Sequence
+
+WARMUP_STEPS = 2
+
+
+def measure_pipeline(
+    model,
+    featurize: Callable,
+    chunks: Sequence,
+    warmup_steps: int = WARMUP_STEPS,
+) -> dict:
+    """Run every chunk through featurize → model.step with one-chunk
+    prefetch; returns {"tweets_per_sec", "seconds", "batches", "final_mse"}.
+    ``featurize(chunk)`` must return a device-ready batch; ``model.step``
+    must return a StepOutput (its ``mse`` is used for the final sync)."""
+    n = sum(len(c) for c in chunks)
+
+    warm = featurize(chunks[0])
+    for _ in range(warmup_steps):
+        model.step(warm)
+
+    t0 = time.perf_counter()
+    last = None
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        pending = pool.submit(featurize, chunks[0])
+        for nxt in chunks[1:]:
+            batch = pending.result()
+            pending = pool.submit(featurize, nxt)
+            last = model.step(batch)
+        last = model.step(pending.result())
+    last.mse.block_until_ready()
+    dt = time.perf_counter() - t0
+    return {
+        "tweets_per_sec": n / dt,
+        "seconds": dt,
+        "batches": len(chunks),
+        "final_mse": float(last.mse),
+    }
