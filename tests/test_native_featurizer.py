@@ -124,3 +124,22 @@ def test_thread_env_non_integer_falls_back_to_auto(monkeypatch):
     s = Status(retweeted_status=Status(text="hello world", retweet_count=500))
     batch = feat.featurize_batch([s], pre_filtered=True)  # must not raise
     assert batch.num_valid == 1
+
+
+def test_custom_label_fn_uses_native_hashing_with_python_labels():
+    from twtml_tpu.features.sentiment import sentiment_label
+
+    feat = Featurizer(now_ms=0)
+    feat.label_fn = sentiment_label
+    keep = [
+        Status(retweeted_status=Status(text=t, retweet_count=500))
+        for t in ("i love this great day", "terrible awful broken mess", "neutral words only")
+    ]
+    fast = feat._featurize_batch_native(keep, 0, 0)
+    assert fast is not None  # label_fn no longer forces the python path
+    from twtml_tpu.features.batch import pad_feature_batch
+
+    slow = pad_feature_batch([feat.featurize(s) for s in keep])
+    assert rows_as_dicts(fast)[:3] == rows_as_dicts(slow)[:3]
+    np.testing.assert_array_equal(fast.label[:3], slow.label[:3])
+    assert list(fast.label[:3]) == [1.0, 0.0, 1.0]

@@ -162,12 +162,14 @@ def main(argv=None) -> None:
     force_cpu = bool(os.environ.get("TWTML_BENCH_CPU"))
 
     if child:
+        real = os.environ.get("TWTML_REAL_DEVICES")
         if child == "sharded_dp4" and (
-            force_cpu or int(os.environ.get("TWTML_REAL_DEVICES", "1")) < 4
+            force_cpu or (real is not None and int(real) < 4)
         ):
             # parent saw <4 real chips (or CPU was requested): run the mesh
             # on 4 virtual CPU devices — must happen before this process
-            # initializes any backend
+            # initializes any backend. Invoked directly (no parent, env
+            # unset), real devices win and run_config skips below 4.
             from twtml_tpu.utils import force_virtual_cpu_devices
 
             force_virtual_cpu_devices(4)
@@ -198,6 +200,7 @@ def main(argv=None) -> None:
 
     lines = []
     for name in CONFIGS:
+        proc = None
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--config", name,
@@ -207,11 +210,13 @@ def main(argv=None) -> None:
             rec = json.loads(proc.stdout.strip().splitlines()[-1])
         except subprocess.TimeoutExpired:
             rec = {"config": name, "error": "timeout (1800s)"}
-        except Exception:
-            rec = {
-                "config": name,
-                "error": (proc.stderr or proc.stdout).strip()[-400:],
-            }
+        except Exception as exc:
+            detail = (
+                (proc.stderr or proc.stdout).strip()[-400:]
+                if proc is not None
+                else repr(exc)
+            )
+            rec = {"config": name, "error": detail}
         lines.append(rec)
         print(json.dumps(rec), flush=True)
     if out_path:

@@ -200,8 +200,9 @@ class Featurizer:
         from . import native
         from .batch import _bucket, pad_row_count
 
-        if self.normalize_accents or self.label_fn is not None:
-            return None  # python path handles the uncommon configurations
+        if self.normalize_accents:
+            return None  # python path handles the uncommon configuration
+            # (accent stripping changes the hashed units themselves)
         if not native.available():
             return None
         n = len(keep)
@@ -249,6 +250,11 @@ class Featurizer:
             )
             numeric[:n, :3] = raw[:, :3] * 1e-12
             numeric[:n, 3] = (now - raw[:, 3]) * 1e-14
-            label[:n] = raw[:, 4]
+            if self.label_fn is None:
+                label[:n] = raw[:, 4]
+            else:
+                # custom labels (e.g. lexicon sentiment) are host-side
+                # per-status Python either way; the hashing still runs native
+                label[:n] = [self.label_fn(s) for s in keep]
             mask[:n] = 1.0
         return FeatureBatch(token_idx, token_val, numeric, label, mask)
