@@ -214,9 +214,9 @@ def main(argv=None) -> None:
             detail = (
                 (proc.stderr or proc.stdout).strip()[-400:]
                 if proc is not None
-                else repr(exc)
+                else ""
             )
-            rec = {"config": name, "error": detail}
+            rec = {"config": name, "error": detail or repr(exc)}
         lines.append(rec)
         print(json.dumps(rec), flush=True)
     if out_path:
