@@ -23,12 +23,15 @@ import sys
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
-N_TWEETS = 16384
+N_TWEETS = 65536
 BATCH = 2048
 WARMUP_BATCHES = 2
+REPEATS = 3  # best-of — robust to multi-second transport stalls
 
 
-def measure(n_tweets: int = N_TWEETS, batch_size: int = BATCH) -> dict:
+def measure(
+    n_tweets: int = N_TWEETS, batch_size: int = BATCH, repeats: int = REPEATS
+) -> dict:
     import numpy as np  # noqa: F401
 
     from twtml_tpu.features.featurizer import Featurizer
@@ -46,7 +49,9 @@ def measure(n_tweets: int = N_TWEETS, batch_size: int = BATCH) -> dict:
     def featurize(chunk):
         return feat.featurize_batch(chunk, row_bucket=batch_size, pre_filtered=True)
 
-    out = measure_pipeline(model, featurize, chunks, warmup_steps=WARMUP_BATCHES)
+    out = measure_pipeline(
+        model, featurize, chunks, warmup_steps=WARMUP_BATCHES, repeats=repeats
+    )
     del out["batches"]
     return out
 
@@ -77,16 +82,17 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        print(json.dumps(measure(n_tweets=4096)))
+        print(json.dumps(measure(n_tweets=4096, repeats=2)))
         return
     if child == "device":
         print(json.dumps(measure()))
         return
 
-    # device measurement with a watchdog (TWTML_BENCH_TIMEOUT seconds,
-    # default 900): a dead TPU tunnel yields a CPU-fallback record instead
-    # of a hang and no record at all
-    timeout = float(os.environ.get("TWTML_BENCH_TIMEOUT", "900"))
+    # device measurement with a watchdog (TWTML_BENCH_TIMEOUT seconds):
+    # a dead TPU tunnel yields a CPU-fallback record instead of a hang and
+    # no record at all. Healthy run ≈ compile (20-40 s) + 3×~1 s passes; the
+    # margin covers a degraded-but-alive tunnel without tripping on it.
+    timeout = float(os.environ.get("TWTML_BENCH_TIMEOUT", "1200"))
     device_result, device_err = _run_child("device", timeout)
     cpu_result, cpu_err = _run_child("cpu", timeout)
     cpu_rate = cpu_result["tweets_per_sec"] if cpu_result else None

@@ -333,6 +333,11 @@ class StreamingSGDModel:
         self._weights = jnp.asarray(weights, dtype=self.dtype)
         return self
 
+    def reset(self) -> "StreamingSGDModel":
+        """Back to MLlib's initial state: zero weights (LinearRegression.scala:32)."""
+        self._weights = zero_weights(self.num_text_features, self.dtype)
+        return self
+
     @property
     def latest_weights(self):
         import numpy as np

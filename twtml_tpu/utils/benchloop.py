@@ -1,12 +1,23 @@
 """Shared measurement loop for the benchmarks (bench.py, tools/bench_suite.py).
 
-The double-buffered pipeline under test: featurize chunk k+1 on a host
-thread while the device runs chunk k (SURVEY.md §7 hard part (c) — hiding
-host featurization latency behind device steps).
+The pipeline under test is the streaming hot path: featurize chunk k+1 on a
+host thread while the device runs chunk k (SURVEY.md §7 hard part (c) —
+hiding host featurization latency behind device steps). Two measured-on-TPU
+policies baked in:
+
+- **Per-step sync.** Each step's stats are fetched before the next dispatch,
+  exactly like the real streaming loop (telemetry consumes every batch's
+  Stats, SessionStats.scala:22-34). It is also required for honest timing
+  over a remote-tunnel device: an unbounded async dispatch queue floods the
+  transport and collapses throughput ~10x.
+- **Prefetch only helps with >1 usable host CPU.** On a single-CPU host the
+  worker thread only adds GIL/context-switch churn to the featurize+dispatch
+  timeshare, so the loop runs inline there.
 """
 
 from __future__ import annotations
 
+import os
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Sequence
@@ -14,36 +25,73 @@ from typing import Callable, Sequence
 WARMUP_STEPS = 2
 
 
+def _usable_cpus() -> int:
+    """CPUs this process may actually run on (affinity/cgroup aware)."""
+    try:
+        return len(os.sched_getaffinity(0))
+    except AttributeError:  # non-Linux
+        return os.cpu_count() or 1
+
+
+def _run_once(model, featurize, chunks, prefetch: bool):
+    """One timed pass; returns (elapsed seconds, last StepOutput)."""
+    t0 = time.perf_counter()
+    if prefetch:
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            pending = pool.submit(featurize, chunks[0])
+            for nxt in chunks[1:]:
+                batch = pending.result()
+                pending = pool.submit(featurize, nxt)
+                model.step(batch).mse.block_until_ready()
+            last = model.step(pending.result())
+            last.mse.block_until_ready()
+    else:
+        for chunk in chunks:
+            last = model.step(featurize(chunk))
+            last.mse.block_until_ready()
+    return time.perf_counter() - t0, last
+
+
 def measure_pipeline(
     model,
     featurize: Callable,
     chunks: Sequence,
     warmup_steps: int = WARMUP_STEPS,
+    repeats: int = 1,
+    prefetch: bool | None = None,
 ) -> dict:
-    """Run every chunk through featurize → model.step with one-chunk
-    prefetch; returns {"tweets_per_sec", "seconds", "batches", "final_mse"}.
+    """Run every chunk through featurize → model.step; returns
+    {"tweets_per_sec", "seconds", "batches", "final_mse"}.
+
     ``featurize(chunk)`` must return a device-ready batch; ``model.step``
-    must return a StepOutput (its ``mse`` is used for the final sync)."""
+    must return a StepOutput (its ``mse`` is the per-step sync point).
+    ``repeats`` > 1 re-runs the whole pass and reports the fastest one —
+    the sustained-capability number, robust to transport jitter (the tunnel
+    to a remote accelerator stalls in multi-second bursts). When the model
+    exposes ``reset()`` its weights are zeroed before every timed pass, so
+    each pass is the identical single-streaming-pass program and
+    ``final_mse`` is repeat-count-independent.
+    """
     n = sum(len(c) for c in chunks)
+    if prefetch is None:
+        prefetch = _usable_cpus() > 1
+    resettable = hasattr(model, "reset")
 
     warm = featurize(chunks[0])
     for _ in range(warmup_steps):
-        model.step(warm)
+        model.step(warm).mse.block_until_ready()
 
-    t0 = time.perf_counter()
-    last = None
-    with ThreadPoolExecutor(max_workers=1) as pool:
-        pending = pool.submit(featurize, chunks[0])
-        for nxt in chunks[1:]:
-            batch = pending.result()
-            pending = pool.submit(featurize, nxt)
-            last = model.step(batch)
-        last = model.step(pending.result())
-    last.mse.block_until_ready()
-    dt = time.perf_counter() - t0
+    best_dt, final_mse = None, None
+    for _ in range(max(1, repeats)):
+        if resettable:
+            model.reset()
+        dt, last = _run_once(model, featurize, chunks, prefetch)
+        if best_dt is None or dt < best_dt:
+            best_dt = dt
+        final_mse = float(last.mse)  # identical across passes when resettable
     return {
-        "tweets_per_sec": n / dt,
-        "seconds": dt,
+        "tweets_per_sec": n / best_dt,
+        "seconds": best_dt,
         "batches": len(chunks),
-        "final_mse": float(last.mse),
+        "final_mse": final_mse,
     }
