@@ -26,7 +26,8 @@ sys.path.insert(0, REPO)
 N_TWEETS = 65536
 BATCH = 2048
 WARMUP_BATCHES = 2
-REPEATS = 3  # best-of — robust to multi-second transport stalls
+REPEATS = 6  # best-of — passes are ~0.3 s, transport stalls come in
+# multi-second bursts, so more short passes = better odds of a clean window
 
 
 def measure(
@@ -90,7 +91,7 @@ def main() -> None:
 
     # device measurement with a watchdog (TWTML_BENCH_TIMEOUT seconds):
     # a dead TPU tunnel yields a CPU-fallback record instead of a hang and
-    # no record at all. Healthy run ≈ compile (20-40 s) + 3×~1 s passes; the
+    # no record at all. Healthy run ≈ compile (20-40 s) + 6×~0.3 s passes; the
     # margin covers a degraded-but-alive tunnel without tripping on it.
     timeout = float(os.environ.get("TWTML_BENCH_TIMEOUT", "1200"))
     device_result, device_err = _run_child("device", timeout)

@@ -112,3 +112,62 @@ def test_accent_normalization_optional():
     assert norm == expected_norm
     assert raw == hashing_tf_counts(char_bigrams("café"), 1000)
     assert raw != norm
+
+def test_compact_wire_dtypes(statuses, feat):
+    """Default 1004-dim schema travels int16 indices + uint16 counts; the
+    wire dtype is a schema decision (stable across batches), not data-sniffed
+    (host→device transfer is the streaming hot loop's bottleneck)."""
+    batch = feat.featurize_batch(statuses)
+    assert batch.token_idx.dtype == np.int16
+    assert batch.token_val.dtype == np.uint16
+    # an empty batch keeps the exact same dtypes — one compiled program
+    empty = feat.featurize_batch([])
+    assert empty.token_idx.dtype == np.int16
+    assert empty.token_val.dtype == np.uint16
+
+
+def test_compact_wire_dtypes_large_feature_space(statuses):
+    """2^18-dim hashing keeps int32 indices (int16 can't address them)."""
+    feat = Featurizer(num_text_features=2**18, now_ms=0)
+    batch = feat.featurize_batch(statuses)
+    assert batch.token_idx.dtype == np.int32
+    assert batch.token_val.dtype == np.uint16
+
+
+def test_compact_wire_dtypes_lossless(statuses, feat):
+    """Compact batch decodes to the identical sparse features as the
+    python ground-truth path."""
+    batch = feat.featurize_batch(statuses)
+    kept = [s for s in statuses if feat.filtrate(s)]
+    for i, s in enumerate(kept):
+        expected = feat.featurize_text(s)
+        got = {
+            int(ix): float(v)
+            for ix, v in zip(batch.token_idx[i], batch.token_val[i])
+            if v
+        }
+        assert got == expected
+
+
+def test_pad_feature_batch_non_count_values_stay_float():
+    """A generic caller with real-valued token_val (counts=False default)
+    keeps float32 on the wire — never downcast by data coincidence."""
+    from twtml_tpu.features.batch import pad_feature_batch
+
+    rows = [({1: 2.0, 3: 1.0}, np.zeros(4, np.float32), 5.0)]  # integral...
+    batch = pad_feature_batch(rows, num_features=1004)
+    assert batch.token_val.dtype == np.float32  # ...but schema says no counts
+    assert batch.token_idx.dtype == np.int16  # indices still compact
+
+def test_compact_tokens_misdeclared_schema_raises():
+    """Out-of-range indices or counts fail loudly instead of silently
+    wrapping (int16) or switching wire dtype mid-stream (float32)."""
+    from twtml_tpu.features.batch import compact_tokens
+
+    idx = np.array([[1, 40000]], dtype=np.int32)
+    val = np.array([[1.0, 1.0]], dtype=np.float32)
+    with pytest.raises(ValueError):
+        compact_tokens(idx, val, 1000, counts=True)
+    big = np.array([[70000.0]], dtype=np.float32)
+    with pytest.raises(ValueError):
+        compact_tokens(np.array([[1]], np.int32), big, 1000, counts=True)

@@ -23,11 +23,16 @@ class FeatureBatch(NamedTuple):
     moves them to device; as a NamedTuple it is automatically a JAX pytree.
 
     Shapes (B = padded rows, L = padded tokens/tweet):
-      token_idx: int32  [B, L] — hashed bigram indices into [0, numTextFeatures)
-      token_val: float32[B, L] — term-frequency counts (0 where padded)
+      token_idx: int  [B, L] — hashed bigram indices into [0, numTextFeatures)
+      token_val: num  [B, L] — term-frequency counts (0 where padded)
       numeric:   float32[B, 4] — scaled followers/favourites/friends/age feats
       label:     float32[B]    — retweet count of the retweeted status
       mask:      float32[B]    — 1.0 for real rows, 0.0 for padding
+
+    ``token_idx``/``token_val`` travel in the narrowest lossless dtype
+    (int16/uint16 when the feature space and counts fit — see
+    ``compact_tokens``): host→device transfer is the measured bottleneck of
+    the streaming hot loop, and the learner steps upcast on device.
     """
 
     token_idx: np.ndarray
@@ -39,6 +44,45 @@ class FeatureBatch(NamedTuple):
     @property
     def num_valid(self) -> int:
         return int(self.mask.sum())
+
+
+def compact_tokens(
+    token_idx: np.ndarray,
+    token_val: np.ndarray,
+    num_features: int,
+    counts: bool = False,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Downcast the token arrays to the narrowest lossless wire dtype.
+
+    ``num_features`` is the text-index space: indices lie in
+    [0, num_features), so they fit int16 whenever num_features ≤ 2^15 (the
+    1000-dim default does; the 2^18-dim config keeps int32). Values go to
+    uint16 only when the caller declares them term-frequency counts
+    (``counts=True``) — a schema property, NOT sniffed from the data, so
+    every batch of a stream shares one dtype (one compiled program, and
+    multi-host global-batch assembly sees matching per-process dtypes). The
+    learner steps upcast on device, so this only changes wire bytes.
+
+    A misdeclared schema (an index outside the declared space, or a
+    ``counts=True`` value exceeding uint16 — counts are bounded by a tweet's
+    bigram count, ≪ 2^16) raises rather than silently wrapping or switching
+    dtype mid-stream.
+    """
+    if 0 < num_features <= np.iinfo(np.int16).max + 1:
+        if token_idx.size and token_idx.max() >= num_features:
+            raise ValueError(
+                f"token index {int(token_idx.max())} outside the declared "
+                f"feature space [0, {num_features})"
+            )
+        token_idx = token_idx.astype(np.int16)
+    if counts:
+        if token_val.size and token_val.max() > np.iinfo(np.uint16).max:
+            raise ValueError(
+                f"counts=True but token value {float(token_val.max())} "
+                "exceeds uint16"
+            )
+        token_val = token_val.astype(np.uint16)
+    return token_idx, token_val
 
 
 def _bucket(n: int, minimum: int = 8) -> int:
@@ -64,6 +108,8 @@ def pad_feature_batch(
     row_bucket: int = 0,
     token_bucket: int = 0,
     row_multiple: int = 1,
+    num_features: int = 0,
+    counts: bool = False,
 ) -> FeatureBatch:
     """Assemble per-tweet sparse features into one padded FeatureBatch.
 
@@ -84,11 +130,14 @@ def pad_feature_batch(
     label = np.zeros((b,), dtype=np.float32)
     mask = np.zeros((b,), dtype=np.float32)
 
-    for i, (counts, nums, lab) in enumerate(rows):
-        for j, (idx, val) in enumerate(counts.items()):
+    for i, (text_counts, nums, lab) in enumerate(rows):
+        for j, (idx, val) in enumerate(text_counts.items()):
             token_idx[i, j] = idx
             token_val[i, j] = val
         numeric[i] = nums
         label[i] = lab
         mask[i] = 1.0
+    token_idx, token_val = compact_tokens(
+        token_idx, token_val, num_features, counts=counts
+    )
     return FeatureBatch(token_idx, token_val, numeric, label, mask)

@@ -30,7 +30,12 @@ from typing import Any, Callable
 
 import numpy as np
 
-from .batch import NUM_NUMBER_FEATURES, FeatureBatch, pad_feature_batch
+from .batch import (
+    NUM_NUMBER_FEATURES,
+    FeatureBatch,
+    compact_tokens,
+    pad_feature_batch,
+)
 from .hashing import char_bigrams, hashing_tf_counts
 
 
@@ -188,9 +193,12 @@ class Featurizer:
         if fast is not None:
             return fast
         rows = [self.featurize(s) for s in keep]
+        # token_val here is always hashing_tf_counts output — counts by
+        # construction (label_fn customizes labels, never token values)
         return pad_feature_batch(
             rows, row_bucket=row_bucket, token_bucket=token_bucket,
-            row_multiple=row_multiple,
+            row_multiple=row_multiple, num_features=self.num_text_features,
+            counts=True,
         )
 
     def _featurize_batch_native(
@@ -257,4 +265,7 @@ class Featurizer:
                 # per-status Python either way; the hashing still runs native
                 label[:n] = [self.label_fn(s) for s in keep]
             mask[:n] = 1.0
+        token_idx, token_val = compact_tokens(
+            token_idx, token_val, self.num_text_features, counts=True
+        )
         return FeatureBatch(token_idx, token_val, numeric, label, mask)

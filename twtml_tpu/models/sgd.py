@@ -179,35 +179,39 @@ def make_sgd_train_step(
 
     def _predict_raw(weights, batch: FeatureBatch, x_dense):
         if sparse:
-            dtype = weights.dtype
             return sparse_predict(
                 weights[:f_text],
                 weights[f_text:],
                 batch.token_idx,
-                batch.token_val.astype(dtype),
-                batch.numeric.astype(dtype),
+                batch.token_val,
+                batch.numeric.astype(weights.dtype),
             )
         return x_dense @ weights
 
     def _grad_sum(batch: FeatureBatch, x_dense, residual):
         if sparse:
-            dtype = residual.dtype
             g_text = sparse_grad_text(
-                batch.token_idx, batch.token_val.astype(dtype), residual, f_text
+                batch.token_idx, batch.token_val, residual, f_text
             )
-            g_num = residual @ batch.numeric.astype(dtype)
+            g_num = residual @ batch.numeric.astype(residual.dtype)
             return jnp.concatenate([g_text, g_num])
         return x_dense.T @ residual
 
     def train_step(weights, batch: FeatureBatch):
         dtype = weights.dtype
+        # tokens arrive in a compact wire dtype (batch.compact_tokens);
+        # upcast once on device before any gather/scatter
+        batch = batch._replace(
+            token_idx=batch.token_idx.astype(jnp.int32),
+            token_val=batch.token_val.astype(dtype),
+        )
         mask = batch.mask.astype(dtype)
         labels = batch.label.astype(dtype)
         x_dense = None
         if not sparse:
             x_dense = jnp.concatenate(
                 [
-                    densify_text(batch.token_idx, batch.token_val.astype(dtype), f_text),
+                    densify_text(batch.token_idx, batch.token_val, f_text),
                     batch.numeric.astype(dtype),
                 ],
                 axis=1,

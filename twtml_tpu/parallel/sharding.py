@@ -88,7 +88,8 @@ def _make_feature_sharded_step(
         token_val = batch.token_val.astype(dtype)
         numeric = batch.numeric.astype(dtype)
         lo = lax.axis_index(model_axis) * f_text_local
-        rel = batch.token_idx - lo
+        # compact wire dtype (batch.compact_tokens) → int32 before index math
+        rel = batch.token_idx.astype(jnp.int32) - lo
         in_slice = ((rel >= 0) & (rel < f_text_local)).astype(dtype)
         rel = jnp.clip(rel, 0, f_text_local - 1)
         local_val = token_val * in_slice  # zero out tokens outside this slice
