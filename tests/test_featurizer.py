@@ -171,3 +171,23 @@ def test_compact_tokens_misdeclared_schema_raises():
     big = np.array([[70000.0]], dtype=np.float32)
     with pytest.raises(ValueError):
         compact_tokens(np.array([[1]], np.int32), big, 1000, counts=True)
+
+def test_compact_tokens_rejects_fractional_and_negative():
+    """counts=True values must survive the uint16 round-trip exactly:
+    TF-IDF-style fractional weights, negatives, and negative indices all
+    raise instead of silently truncating/wrapping."""
+    from twtml_tpu.features.batch import compact_tokens
+
+    ok_idx = np.array([[1, 2]], dtype=np.int32)
+    for bad in ([[0.7, 1.0]], [[-1.0, 1.0]]):
+        with pytest.raises(ValueError):
+            compact_tokens(
+                ok_idx, np.array(bad, dtype=np.float32), 1000, counts=True
+            )
+    with pytest.raises(ValueError):
+        compact_tokens(
+            np.array([[-5, 2]], dtype=np.int32),
+            np.array([[1.0, 1.0]], dtype=np.float32),
+            1000,
+            counts=True,
+        )

@@ -51,6 +51,7 @@ def compact_tokens(
     token_val: np.ndarray,
     num_features: int,
     counts: bool = False,
+    validate: bool = True,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Downcast the token arrays to the narrowest lossless wire dtype.
 
@@ -63,25 +64,32 @@ def compact_tokens(
     multi-host global-batch assembly sees matching per-process dtypes). The
     learner steps upcast on device, so this only changes wire bytes.
 
-    A misdeclared schema (an index outside the declared space, or a
-    ``counts=True`` value exceeding uint16 — counts are bounded by a tweet's
-    bigram count, ≪ 2^16) raises rather than silently wrapping or switching
-    dtype mid-stream.
+    A misdeclared schema raises rather than silently wrapping or switching
+    dtype mid-stream: indices outside [0, num_features), and ``counts=True``
+    values that don't survive the uint16 round-trip (fractional, negative,
+    or ≥ 2^16 — true term-frequency counts are bounded by a tweet's bigram
+    count, ≪ 2^16). ``validate=False`` skips those data passes for callers
+    whose arrays are in-range by construction (the native featurizer path:
+    the C hasher emits ``hash % num_features`` indices and per-tweet counts
+    ≤ the token bucket).
     """
     if 0 < num_features <= np.iinfo(np.int16).max + 1:
-        if token_idx.size and token_idx.max() >= num_features:
+        if validate and token_idx.size and (
+            token_idx.min() < 0 or token_idx.max() >= num_features
+        ):
             raise ValueError(
-                f"token index {int(token_idx.max())} outside the declared "
-                f"feature space [0, {num_features})"
+                "token indices outside the declared feature space "
+                f"[0, {num_features})"
             )
         token_idx = token_idx.astype(np.int16)
     if counts:
-        if token_val.size and token_val.max() > np.iinfo(np.uint16).max:
+        compacted = token_val.astype(np.uint16)
+        if validate and not np.array_equal(compacted, token_val):
             raise ValueError(
-                f"counts=True but token value {float(token_val.max())} "
-                "exceeds uint16"
+                "counts=True but token values are not uint16-exact "
+                "(fractional, negative, or >= 2**16)"
             )
-        token_val = token_val.astype(np.uint16)
+        token_val = compacted
     return token_idx, token_val
 
 

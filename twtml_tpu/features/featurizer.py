@@ -242,30 +242,24 @@ class Featurizer:
         label = np.zeros((b,), dtype=np.float32)
         mask = np.zeros((b,), dtype=np.float32)
         if n:
-            # one pass over the objects; columns scaled vectorized
-            raw = np.array(
-                [
-                    (
-                        o.followers_count,
-                        o.favourites_count,
-                        o.friends_count,
-                        o.created_at_ms,
-                        o.retweet_count,
-                    )
-                    for o in originals
-                ],
-                dtype=np.float64,
-            )
-            numeric[:n, :3] = raw[:, :3] * 1e-12
-            numeric[:n, 3] = (now - raw[:, 3]) * 1e-14
+            # per-column fromiter: ~4x cheaper than np.array over a
+            # list of per-status attribute tuples
+            def col(get):
+                return np.fromiter((get(o) for o in originals), np.float64, n)
+
+            numeric[:n, 0] = col(lambda o: o.followers_count) * 1e-12
+            numeric[:n, 1] = col(lambda o: o.favourites_count) * 1e-12
+            numeric[:n, 2] = col(lambda o: o.friends_count) * 1e-12
+            numeric[:n, 3] = (now - col(lambda o: o.created_at_ms)) * 1e-14
             if self.label_fn is None:
-                label[:n] = raw[:, 4]
+                label[:n] = col(lambda o: o.retweet_count)
             else:
                 # custom labels (e.g. lexicon sentiment) are host-side
                 # per-status Python either way; the hashing still runs native
                 label[:n] = [self.label_fn(s) for s in keep]
             mask[:n] = 1.0
         token_idx, token_val = compact_tokens(
-            token_idx, token_val, self.num_text_features, counts=True
+            token_idx, token_val, self.num_text_features, counts=True,
+            validate=False,  # C hasher output is in-range by construction
         )
         return FeatureBatch(token_idx, token_val, numeric, label, mask)

@@ -90,11 +90,24 @@ def _thread_count_from_env() -> int:
 
 def encode_texts(texts: list[str]) -> tuple[np.ndarray, np.ndarray]:
     """One-pass UTF-16-LE encode of a batch: (units, offsets). Callers reuse
-    the offsets for token-bucket sizing so texts are encoded exactly once."""
-    encoded = [t.encode("utf-16-le") for t in texts]
+    the offsets for token-bucket sizing so texts are encoded exactly once.
+
+    One join + one encode instead of per-text encodes (2048 small encodes
+    were ~40% of the whole featurize hot path). UTF-16-LE is BOM-free and
+    concatenation-safe, so per-text unit counts are all that's needed to
+    split the joined buffer: len(t) when every char is BMP (1 unit each),
+    with a per-text re-encode only in the rare astral-emoji case."""
+    joined = "".join(texts)
+    units = np.frombuffer(joined.encode("utf-16-le"), dtype=np.uint16)
     offsets = np.zeros(len(texts) + 1, dtype=np.int64)
-    np.cumsum([len(e) >> 1 for e in encoded], out=offsets[1:])
-    units = np.frombuffer(b"".join(encoded), dtype=np.uint16)
+    if units.size == len(joined):  # no astral chars: 1 unit per char
+        counts = [len(t) for t in texts]
+    else:
+        counts = [
+            len(t) if t.isascii() else len(t.encode("utf-16-le")) >> 1
+            for t in texts
+        ]
+    np.cumsum(counts, out=offsets[1:])
     if units.size == 0:
         units = np.zeros(1, dtype=np.uint16)
     return units, offsets
