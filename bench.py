@@ -48,7 +48,12 @@ def measure(
     chunks = [statuses[i : i + batch_size] for i in range(0, n_tweets, batch_size)]
 
     def featurize(chunk):
-        return feat.featurize_batch(chunk, row_bucket=batch_size, pre_filtered=True)
+        # on-device featurization wire format: the host encodes + pads raw
+        # code units; bigram hashing happens inside the fused device step
+        # (bit-identical features — tests/test_device_hash.py)
+        return feat.featurize_batch_units(
+            chunk, row_bucket=batch_size, pre_filtered=True
+        )
 
     out = measure_pipeline(
         model, featurize, chunks, warmup_steps=WARMUP_BATCHES, repeats=repeats

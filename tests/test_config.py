@@ -160,3 +160,17 @@ def test_application_conf_layering(tmp_path, monkeypatch, clean_properties):
     assert twt("consumerKey") == "abc"
     # untouched keys keep reference defaults
     assert conf.stepSize == 0.005
+
+
+def test_hash_on_flag_and_validation(isolated_env, tmp_path, monkeypatch):
+    assert ConfArguments().hashOn == "device"
+    assert ConfArguments().parse(["--hashOn", "host"]).hashOn == "host"
+    with pytest.raises(SystemExit):
+        ConfArguments().parse(["--hashOn", "gpu"])
+    # config-file typos fail loudly too, not silently fall back (the CLI and
+    # file paths validate identically)
+    bad = tmp_path / "application.conf"
+    bad.write_text('hashOn="Device"\n')
+    monkeypatch.setenv("TWTML_CONFIG", str(bad))
+    with pytest.raises(ValueError):
+        ConfArguments()

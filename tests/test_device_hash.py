@@ -1,0 +1,191 @@
+"""On-device featurization parity (ops/text_hash.py + UnitBatch path).
+
+The device bigram hash must produce features bit-identical to the host
+ground truth (features/hashing.py, itself MLlib-HashingTF-compatible —
+MllibHelper.scala:42-56), and a learner fed UnitBatches must trace the exact
+same weights/stats as one fed host-hashed FeatureBatches.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from twtml_tpu.features import Featurizer, Status
+from twtml_tpu.features.hashing import char_bigrams, hashing_tf_counts
+from twtml_tpu.models import (
+    StreamingLinearRegressionWithSGD,
+    StreamingLogisticRegressionWithSGD,
+)
+from twtml_tpu.ops.sparse import densify_text
+from twtml_tpu.ops.text_hash import hash_bigrams_device
+
+DATA = os.path.join(os.path.dirname(__file__), "data", "tweets.jsonl")
+
+
+@pytest.fixture()
+def statuses():
+    with open(DATA, encoding="utf-8") as fh:
+        return [Status.from_json(json.loads(line)) for line in fh if line.strip()]
+
+
+@pytest.fixture()
+def feat():
+    return Featurizer(now_ms=1785320000000)
+
+
+def _status_with_text(text, count=250):
+    return Status(
+        text="RT wrapper",
+        retweeted_status=Status(text=text, retweet_count=count),
+    )
+
+
+def _device_counts(text, num_features=1000):
+    """Hash one text on device, return {idx: count} like hashing_tf_counts."""
+    feat = Featurizer(now_ms=0)
+    batch = feat.featurize_batch_units([_status_with_text(text)], pre_filtered=True)
+    idx, val = hash_bigrams_device(
+        jnp.asarray(batch.units), jnp.asarray(batch.length), num_features
+    )
+    idx, val = np.asarray(idx[0]), np.asarray(val[0])
+    out: dict[int, float] = {}
+    for i, v in zip(idx[val > 0], val[val > 0]):
+        out[int(i)] = out.get(int(i), 0.0) + float(v)
+    return out
+
+
+@pytest.mark.parametrize(
+    "text",
+    [
+        "breaking news from the summit today!",
+        "",  # no terms
+        "a",  # sliding(2) yields the 1-char string itself
+        "ab",
+        "aaaa",  # repeated bigram -> counts > 1
+        "café résumé",  # accents (hashed raw by default)
+        "fire \U0001f525\U0001f525 alert",  # astral: surrogate-pair windows
+        "\U0001f600",  # lone astral char: two units, one bigram
+    ],
+)
+def test_device_hash_matches_ground_truth(text):
+    expected = hashing_tf_counts(char_bigrams(text.lower()), 1000)
+    assert _device_counts(text.lower()) == expected
+
+
+def test_unit_batch_densifies_identically(statuses, feat):
+    """Dense [B, F] matrices from both wire formats are equal elementwise."""
+    host = feat.featurize_batch(statuses)
+    dev = feat.featurize_batch_units(statuses)
+    assert dev.units.dtype == np.uint16
+    np.testing.assert_array_equal(host.mask, dev.mask)
+    np.testing.assert_array_equal(host.label, dev.label)
+    np.testing.assert_allclose(host.numeric, dev.numeric, rtol=1e-6)
+    d_idx, d_val = hash_bigrams_device(
+        jnp.asarray(dev.units), jnp.asarray(dev.length), 1000
+    )
+    dense_host = np.asarray(
+        densify_text(
+            jnp.asarray(host.token_idx, jnp.int32),
+            jnp.asarray(host.token_val, jnp.float32),
+            1000,
+        )
+    )
+    dense_dev = np.asarray(densify_text(d_idx, d_val, 1000))
+    np.testing.assert_array_equal(dense_host, dense_dev)
+
+
+def test_unit_batch_row_and_unit_buckets(statuses, feat):
+    batch = feat.featurize_batch_units(statuses, row_bucket=32, unit_bucket=128)
+    assert batch.units.shape == (32, 128)
+    assert batch.length.shape == (32,)
+    n = int(batch.mask.sum())
+    assert (batch.length[n:] == 0).all()
+
+
+def test_unit_batch_empty():
+    feat = Featurizer(now_ms=0)
+    batch = feat.featurize_batch_units([])
+    assert batch.mask.sum() == 0
+    assert batch.units.shape[1] >= 2  # device bigram window needs L >= 2
+
+
+def test_unit_batch_accent_normalization():
+    text = "Cafés"
+    feat = Featurizer(now_ms=0, normalize_accents=True)
+    batch = feat.featurize_batch_units(
+        [_status_with_text(text)], pre_filtered=True
+    )
+    counts = hashing_tf_counts(char_bigrams("cafes"), 1000)
+    idx, val = hash_bigrams_device(
+        jnp.asarray(batch.units), jnp.asarray(batch.length), 1000
+    )
+    got: dict[int, float] = {}
+    for i, v in zip(np.asarray(idx[0]), np.asarray(val[0])):
+        if v > 0:
+            got[int(i)] = got.get(int(i), 0.0) + float(v)
+    assert got == counts
+
+
+def test_linear_model_unit_batch_parity(statuses, feat):
+    """Full fused step: UnitBatch and FeatureBatch runs produce identical
+    weights and stats on the same stream of micro-batches."""
+    host_model = StreamingLinearRegressionWithSGD(num_iterations=10)
+    dev_model = StreamingLinearRegressionWithSGD(num_iterations=10)
+    chunks = [statuses[:4], statuses[4:]]
+    for chunk in chunks:
+        out_h = host_model.step(feat.featurize_batch(chunk, row_bucket=8))
+        out_d = dev_model.step(feat.featurize_batch_units(chunk, row_bucket=8))
+        assert float(out_h.count) == float(out_d.count)
+        np.testing.assert_allclose(
+            float(out_h.mse), float(out_d.mse), rtol=1e-5
+        )
+    np.testing.assert_allclose(
+        host_model.latest_weights, dev_model.latest_weights, rtol=1e-5, atol=1e-8
+    )
+
+
+def test_logistic_model_accepts_unit_batches(statuses):
+    feat = Featurizer(now_ms=1785320000000, label_fn=lambda s: 1.0)
+    model = StreamingLogisticRegressionWithSGD(num_iterations=5)
+    out = model.step(feat.featurize_batch_units(statuses))
+    assert float(out.count) == 6.0  # the filtrate-passing fixtures
+
+
+@pytest.mark.parametrize("layout", ["data", "data_model"])
+def test_parallel_model_unit_batch_parity(statuses, feat, layout):
+    """Mesh-sharded steps (both layouts) fed UnitBatches match the
+    single-device host-hashed run."""
+    from twtml_tpu.parallel import ParallelSGDModel, make_mesh
+
+    if layout == "data":
+        mesh = make_mesh(num_data=4)
+    else:
+        mesh = make_mesh(num_data=2, num_model=2)
+    ref = StreamingLinearRegressionWithSGD(num_iterations=10)
+    par = ParallelSGDModel(mesh, num_iterations=10, step_size=0.005)
+    host_b = feat.featurize_batch(statuses, row_bucket=8)
+    unit_b = feat.featurize_batch_units(statuses, row_bucket=8)
+    out_ref = ref.step(host_b)
+    out_par = par.step(unit_b)
+    assert float(out_ref.count) == float(out_par.count)
+    np.testing.assert_allclose(float(out_ref.mse), float(out_par.mse), rtol=1e-5)
+    np.testing.assert_allclose(
+        ref.latest_weights, par.latest_weights, rtol=1e-4, atol=1e-7
+    )
+
+
+def test_sparse_path_accepts_unit_batches(statuses, feat):
+    """2^18-dim config (BASELINE #4) rides the gather/scatter path; device
+    hashing must feed it the same features as host hashing."""
+    f = 2**18
+    big = Featurizer(num_text_features=f, now_ms=1785320000000)
+    m_host = StreamingLinearRegressionWithSGD(num_text_features=f, num_iterations=5)
+    m_dev = StreamingLinearRegressionWithSGD(num_text_features=f, num_iterations=5)
+    m_host.step(big.featurize_batch(statuses))
+    m_dev.step(big.featurize_batch_units(statuses))
+    np.testing.assert_allclose(
+        m_host.latest_weights, m_dev.latest_weights, rtol=1e-5, atol=1e-8
+    )

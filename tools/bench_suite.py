@@ -50,7 +50,8 @@ def _pipeline_rate(model, feat, statuses, batch_size, row_multiple=1, shard=None
     chunks = [statuses[i : i + batch_size] for i in range(0, len(statuses), batch_size)]
 
     def featurize(chunk):
-        b = feat.featurize_batch(
+        # units wire format → bigram hashing on device (ops/text_hash.py)
+        b = feat.featurize_batch_units(
             chunk, row_bucket=batch_size, pre_filtered=True,
             row_multiple=row_multiple,
         )

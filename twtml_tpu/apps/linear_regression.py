@@ -117,6 +117,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     stream = ssc.source_stream(
         build_source(conf), featurizer,
         row_bucket=conf.batchBucket, row_multiple=row_multiple,
+        device_hash=conf.hashOn == "device",
     )
 
     totals = {"count": 0, "batches": 0}

@@ -38,7 +38,8 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
 
     ssc = StreamingContext(batch_interval=conf.seconds)
     stream = ssc.source_stream(
-        build_source(conf), featurizer, row_bucket=conf.batchBucket
+        build_source(conf), featurizer, row_bucket=conf.batchBucket,
+        device_hash=conf.hashOn == "device",
     )
     totals = {"count": 0, "batches": 0}
 

@@ -115,6 +115,11 @@ class ConfArguments:
         self.replayFile: str = conf.get("replayFile", "")
         self.replaySpeed: float = float(conf.get("replaySpeed", "0.0"))
         self.batchBucket: int = int(conf.get("batchBucket", "0"))
+        self.hashOn: str = conf.get("hashOn", "device")
+        if self.hashOn not in ("device", "host"):
+            raise ValueError(
+                f"hashOn must be 'device' or 'host', got {self.hashOn!r}"
+            )
         self.l2Reg: float = float(conf.get("l2Reg", "0.0"))
         self.convergenceTol: float = float(conf.get("convergenceTol", "0.001"))
         self.dtype: str = conf.get("dtype", "float32")
@@ -174,6 +179,9 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
   --replayFile <path.jsonl>                    Tweet replay file (source=replay)
   --replaySpeed <float>                        0 = as-fast-as-possible, else x realtime
   --batchBucket <int>                          Pad batches up to this bucket size (0 = auto)
+  --hashOn <device|host>                       Bigram-hash featurization inside the XLA step
+                                               (device, default) or on the host CPU (host);
+                                               bit-identical features either way. Default: {self.hashOn}
   --l2Reg <float>                              L2 regularization. Default: {self.l2Reg}
   --convergenceTol <float>                     SGD convergence tolerance. Default: {self.convergenceTol}
   --dtype <float32|bfloat16|float64>           Device dtype. Default: {self.dtype}
@@ -234,6 +242,10 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
             self.replaySpeed = float(take())
         elif flag == "--batchBucket":
             self.batchBucket = int(take())
+        elif flag == "--hashOn":
+            self.hashOn = take()
+            if self.hashOn not in ("device", "host"):
+                self.printUsage(1)
         elif flag == "--l2Reg":
             self.l2Reg = float(take())
         elif flag == "--convergenceTol":

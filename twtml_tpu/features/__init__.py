@@ -1,6 +1,6 @@
 from .hashing import java_string_hashcode, hashing_tf_counts, char_bigrams
 from .featurizer import Status, Featurizer
-from .batch import FeatureBatch, pad_feature_batch
+from .batch import FeatureBatch, UnitBatch, pad_feature_batch
 
 __all__ = [
     "java_string_hashcode",
@@ -9,5 +9,6 @@ __all__ = [
     "Status",
     "Featurizer",
     "FeatureBatch",
+    "UnitBatch",
     "pad_feature_batch",
 ]

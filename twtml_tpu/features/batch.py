@@ -46,6 +46,32 @@ class FeatureBatch(NamedTuple):
         return int(self.mask.sum())
 
 
+class UnitBatch(NamedTuple):
+    """A padded micro-batch carrying raw UTF-16 code units instead of
+    host-hashed tokens — the wire format of the on-device featurization path
+    (ops/text_hash.py). The learner hashes bigrams inside the jit step, so
+    host work per tweet drops to encode + pad and the transfer shrinks to
+    2 bytes/unit. Learner steps accept either batch type; both produce
+    bit-identical features (same Java-hashCode bigram hash).
+
+    Shapes (B = padded rows, L = padded units/tweet, L ≥ 2):
+      units:   uint16 [B, L]   — lowercased text as UTF-16-LE code units
+      length:  int32  [B]      — real unit count per row (0 for padding)
+      numeric: float32[B, 4], label: float32[B], mask: float32[B] — as in
+      FeatureBatch.
+    """
+
+    units: np.ndarray
+    length: np.ndarray
+    numeric: np.ndarray
+    label: np.ndarray
+    mask: np.ndarray
+
+    @property
+    def num_valid(self) -> int:
+        return int(self.mask.sum())
+
+
 def compact_tokens(
     token_idx: np.ndarray,
     token_val: np.ndarray,

@@ -55,23 +55,58 @@ def get_lib() -> ctypes.CDLL | None:
             if not os.path.exists(_SRC) or not _build():
                 return None
         try:
-            lib = ctypes.CDLL(_LIB)
-            lib.fasthash_batch.restype = ctypes.c_int32
-            lib.fasthash_batch.argtypes = [
-                ctypes.POINTER(ctypes.c_uint16),  # units
-                ctypes.POINTER(ctypes.c_int64),  # offsets
-                ctypes.c_int32,  # batch
-                ctypes.c_int32,  # num_features
-                ctypes.c_int32,  # l_max
-                ctypes.POINTER(ctypes.c_int32),  # out_idx
-                ctypes.POINTER(ctypes.c_float),  # out_val
-                ctypes.POINTER(ctypes.c_int32),  # out_ntok
-                ctypes.c_int32,  # n_threads (<=0 = auto)
-            ]
-            _lib = lib
+            lib = _load(_LIB)
+        except AttributeError:
+            # stale .so from before a symbol was added (mtime-equal artifact
+            # copy defeats the rebuild check): rebuild once and retry.
+            # Unlink first — dlopen caches by inode, so rebuilding in place
+            # would hand the retry the same stale image; a fresh inode loads.
+            try:
+                os.remove(_LIB)
+            except OSError:
+                pass
+            if not os.path.exists(_SRC) or not _build():
+                log.warning("native featurizer is stale and could not be "
+                            "rebuilt; using python path")
+                return None
+            try:
+                lib = _load(_LIB)
+            except (OSError, AttributeError) as exc:
+                log.warning("native featurizer load failed (%s)", exc)
+                return None
         except OSError as exc:
             log.warning("native featurizer load failed (%s)", exc)
+            return None
+        _lib = lib
         return _lib
+
+
+def _load(path: str) -> ctypes.CDLL:
+    """dlopen + bind every exported symbol; AttributeError = stale library."""
+    lib = ctypes.CDLL(path)
+    lib.fasthash_batch.restype = ctypes.c_int32
+    lib.fasthash_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_uint16),  # units
+        ctypes.POINTER(ctypes.c_int64),  # offsets
+        ctypes.c_int32,  # batch
+        ctypes.c_int32,  # num_features
+        ctypes.c_int32,  # l_max
+        ctypes.POINTER(ctypes.c_int32),  # out_idx
+        ctypes.POINTER(ctypes.c_float),  # out_val
+        ctypes.POINTER(ctypes.c_int32),  # out_ntok
+        ctypes.c_int32,  # n_threads (<=0 = auto)
+    ]
+    lib.pad_units_batch.restype = ctypes.c_int32
+    lib.pad_units_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_uint16),  # units
+        ctypes.POINTER(ctypes.c_int64),  # offsets
+        ctypes.c_int32,  # batch
+        ctypes.c_int32,  # padded_rows
+        ctypes.c_int32,  # l_max
+        ctypes.POINTER(ctypes.c_uint16),  # out_units
+        ctypes.POINTER(ctypes.c_int32),  # out_len
+    ]
+    return lib
 
 
 def available() -> bool:
@@ -111,6 +146,35 @@ def encode_texts(texts: list[str]) -> tuple[np.ndarray, np.ndarray]:
     if units.size == 0:
         units = np.zeros(1, dtype=np.uint16)
     return units, offsets
+
+
+def pad_units(
+    encoded: tuple[np.ndarray, np.ndarray],
+    n: int,
+    padded_rows: int,
+    l_max: int,
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """Ragged (units, offsets) → ([padded_rows, l_max] uint16, [padded_rows]
+    int32 lengths) via the C row-memcpy loop; None if the library is
+    unavailable (caller falls back to the numpy gather)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    units, offsets = encoded
+    buf = np.empty((padded_rows, l_max), dtype=np.uint16)
+    length = np.empty((padded_rows,), dtype=np.int32)
+    max_len = lib.pad_units_batch(
+        units.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        padded_rows,
+        l_max,
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        length.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if max_len > l_max:  # caller sized l_max from these offsets; never expected
+        return None
+    return buf, length
 
 
 def hash_texts(

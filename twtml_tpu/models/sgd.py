@@ -33,9 +33,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..features.batch import NUM_NUMBER_FEATURES, FeatureBatch
+from ..features.batch import NUM_NUMBER_FEATURES, FeatureBatch, UnitBatch
 from ..ops.sparse import densify_text, sparse_grad_text, sparse_predict
 from ..ops.stats import batch_stats
+from ..ops.text_hash import hash_bigrams_device
 from ..utils.rounding import jnp_round_half_up
 from .base import StepOutput
 
@@ -197,8 +198,18 @@ def make_sgd_train_step(
             return jnp.concatenate([g_text, g_num])
         return x_dense.T @ residual
 
-    def train_step(weights, batch: FeatureBatch):
+    def train_step(weights, batch: FeatureBatch | UnitBatch):
         dtype = weights.dtype
+        if isinstance(batch, UnitBatch):
+            # on-device featurization: hash the raw code units inside this
+            # same XLA program (ops/text_hash.py); per-occurrence 1.0 values
+            # scatter/gather to the identical features host hashing ships
+            token_idx, token_val = hash_bigrams_device(
+                batch.units, batch.length, f_text, dtype
+            )
+            batch = FeatureBatch(
+                token_idx, token_val, batch.numeric, batch.label, batch.mask
+            )
         # tokens arrive in a compact wire dtype (batch.compact_tokens);
         # upcast once on device before any gather/scatter
         batch = batch._replace(

@@ -17,7 +17,7 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-from ..features.batch import FeatureBatch
+from ..features.batch import FeatureBatch, UnitBatch
 from ..utils import get_logger
 
 log = get_logger("parallel.distributed")
@@ -59,19 +59,22 @@ def initialize(
         log.debug("jax.distributed not initialized (%s); single-process mode", exc)
 
 
-def host_local_batch_to_global(batch: FeatureBatch, mesh) -> FeatureBatch:
+def host_local_batch_to_global(
+    batch: FeatureBatch | UnitBatch, mesh
+) -> FeatureBatch | UnitBatch:
     """Assemble each host's locally-featurized rows into one global
-    row-sharded batch (multi-host stream sharding). Single-process: no-op
-    beyond device placement."""
+    row-sharded batch (multi-host stream sharding), for either wire format
+    (host-hashed tokens or raw code units). Single-process: no-op beyond
+    device placement."""
     from jax.sharding import NamedSharding
 
-    from .sharding import batch_pspecs
+    from .sharding import _pspecs_for
 
     if jax.process_count() == 1:
         from .sharding import shard_batch
 
         return shard_batch(batch, mesh)
-    specs = batch_pspecs(mesh.axis_names[0])
+    specs = _pspecs_for(type(batch), mesh.axis_names[0])
     arrays = []
     for host_arr, spec in zip(batch, specs):
         sharding = NamedSharding(mesh, spec)
@@ -80,4 +83,4 @@ def host_local_batch_to_global(batch: FeatureBatch, mesh) -> FeatureBatch:
             jax.make_array_from_process_local_data(sharding, np.asarray(host_arr),
                                                    global_shape)
         )
-    return FeatureBatch(*arrays)
+    return type(batch)(*arrays)

@@ -30,11 +30,12 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..features.batch import NUM_NUMBER_FEATURES, FeatureBatch
+from ..features.batch import NUM_NUMBER_FEATURES, FeatureBatch, UnitBatch
 from ..models.base import StepOutput
 from ..models.sgd import make_sgd_train_step, sampling_key, sgd_inner_loop
 from ..ops.sparse import sparse_grad_text, sparse_text_dot
 from ..ops.stats import batch_stats
+from ..ops.text_hash import hash_bigrams_device
 from ..utils.rounding import jnp_round_half_up
 
 
@@ -49,11 +50,31 @@ def batch_pspecs(data_axis: str = "data") -> FeatureBatch:
     )
 
 
-def shard_batch(batch: FeatureBatch, mesh) -> FeatureBatch:
+def unit_batch_pspecs(data_axis: str = "data") -> UnitBatch:
+    """PartitionSpecs sharding a UnitBatch's rows across ``data`` (the
+    on-device-featurization wire format, ops/text_hash.py)."""
+    return UnitBatch(
+        units=P(data_axis, None),
+        length=P(data_axis),
+        numeric=P(data_axis, None),
+        label=P(data_axis),
+        mask=P(data_axis),
+    )
+
+
+def _pspecs_for(batch_cls, data_axis: str):
+    return (
+        unit_batch_pspecs(data_axis)
+        if batch_cls is UnitBatch
+        else batch_pspecs(data_axis)
+    )
+
+
+def shard_batch(batch: FeatureBatch | UnitBatch, mesh):
     """Place a host batch onto the mesh with row sharding (explicit
     device_put so repeated steps don't re-infer layouts)."""
-    specs = batch_pspecs(mesh.axis_names[0])
-    return FeatureBatch(*(
+    specs = _pspecs_for(type(batch), mesh.axis_names[0])
+    return type(batch)(*(
         jax.device_put(arr, NamedSharding(mesh, spec))
         for arr, spec in zip(batch, specs)
     ))
@@ -80,16 +101,24 @@ def _make_feature_sharded_step(
     residual_fn = residual_fn or (lambda raw, label: raw - label)
     prediction_fn = prediction_fn or (lambda raw: raw)
 
-    def step(weights, batch: FeatureBatch):
+    def step(weights, batch: FeatureBatch | UnitBatch):
         w_text, w_num = weights["text"], weights["num"]
         dtype = w_text.dtype
         mask = batch.mask.astype(dtype)
         labels = batch.label.astype(dtype)
-        token_val = batch.token_val.astype(dtype)
+        if isinstance(batch, UnitBatch):
+            # on-device featurization: each data shard hashes its own rows'
+            # code units to GLOBAL indices, then slices per model shard below
+            g_idx, token_val = hash_bigrams_device(
+                batch.units, batch.length, f_text, dtype
+            )
+        else:
+            # compact wire dtype (batch.compact_tokens) → int32 index math
+            g_idx = batch.token_idx.astype(jnp.int32)
+            token_val = batch.token_val.astype(dtype)
         numeric = batch.numeric.astype(dtype)
         lo = lax.axis_index(model_axis) * f_text_local
-        # compact wire dtype (batch.compact_tokens) → int32 before index math
-        rel = batch.token_idx.astype(jnp.int32) - lo
+        rel = g_idx - lo
         in_slice = ((rel >= 0) & (rel < f_text_local)).astype(dtype)
         rel = jnp.clip(rel, 0, f_text_local - 1)
         local_val = token_val * in_slice  # zero out tokens outside this slice
@@ -163,7 +192,6 @@ class ParallelSGDModel:
         self.data_axis = axes[0]
         self.model_axis = axes[1] if len(axes) > 1 else None
         self.num_data = mesh.shape[self.data_axis]
-        in_batch_specs = batch_pspecs(self.data_axis)
         out_pred_spec = P(self.data_axis)
         scalar = P()
 
@@ -215,22 +243,35 @@ class ParallelSGDModel:
             }
             w_spec = {"text": P(self.model_axis), "num": P()}
 
-        sharded = jax.shard_map(
-            step,
-            mesh=mesh,
-            in_specs=(w_spec, in_batch_specs),
-            out_specs=(
-                w_spec,
-                StepOutput(
-                    predictions=out_pred_spec,
-                    count=scalar,
-                    mse=scalar,
-                    real_stdev=scalar,
-                    pred_stdev=scalar,
-                ),
+        # the shard_map is built lazily per wire format (FeatureBatch and
+        # UnitBatch differ in pytree structure, hence in in_specs); a stream
+        # uses one format throughout, so this stays one compiled program
+        self._step_body = step
+        self._w_spec = w_spec
+        self._out_specs = (
+            w_spec,
+            StepOutput(
+                predictions=out_pred_spec,
+                count=scalar,
+                mse=scalar,
+                real_stdev=scalar,
+                pred_stdev=scalar,
             ),
         )
-        self._step = jax.jit(sharded, donate_argnums=0)
+        self._sharded: dict[type, Callable] = {}
+
+    def _step_for(self, batch_cls) -> Callable:
+        fn = self._sharded.get(batch_cls)
+        if fn is None:
+            sharded = jax.shard_map(
+                self._step_body,
+                mesh=self.mesh,
+                in_specs=(self._w_spec, _pspecs_for(batch_cls, self.data_axis)),
+                out_specs=self._out_specs,
+            )
+            fn = jax.jit(sharded, donate_argnums=0)
+            self._sharded[batch_cls] = fn
+        return fn
 
     @classmethod
     def from_conf(cls, conf, mesh, **overrides):
@@ -269,14 +310,14 @@ class ParallelSGDModel:
             self._weights = jnp.asarray(weights)
         return self
 
-    def step(self, batch: FeatureBatch) -> StepOutput:
-        b = batch.token_idx.shape[0]
+    def step(self, batch: FeatureBatch | UnitBatch) -> StepOutput:
+        b = batch.mask.shape[0]
         if b % self.num_data:
             raise ValueError(
                 f"batch rows {b} not divisible by data shards {self.num_data}; "
                 f"set --batchBucket to a multiple of the mesh's data axis"
             )
-        self._weights, out = self._step(self._weights, batch)
+        self._weights, out = self._step_for(type(batch))(self._weights, batch)
         return out
 
     def train_on(self, stream) -> None:

@@ -27,7 +27,7 @@ import threading
 import time
 from typing import Callable
 
-from ..features.batch import FeatureBatch
+from ..features.batch import FeatureBatch, UnitBatch
 from ..features.featurizer import Featurizer, Status
 from ..utils import get_logger
 from .sources import Source
@@ -65,18 +65,31 @@ class FeatureStream(RawStream):
         row_bucket: int = 0,
         token_bucket: int = 0,
         row_multiple: int = 1,
+        device_hash: bool = False,
     ):
         super().__init__()
         self.featurizer = featurizer
         self.row_bucket = row_bucket
         self.token_bucket = token_bucket
         self.row_multiple = row_multiple
+        self.device_hash = device_hash
 
-    def _process(self, statuses: list[Status], batch_time: float) -> FeatureBatch:
-        batch = self.featurizer.featurize_batch(
-            statuses, row_bucket=self.row_bucket, token_bucket=self.token_bucket,
-            row_multiple=self.row_multiple,
-        )
+    def _process(
+        self, statuses: list[Status], batch_time: float
+    ) -> "FeatureBatch | UnitBatch":
+        if self.device_hash:
+            # ship raw code units; the learner hashes bigrams on device
+            # (ops/text_hash.py) — bit-identical features, ~2x host headroom
+            batch = self.featurizer.featurize_batch_units(
+                statuses, row_bucket=self.row_bucket,
+                unit_bucket=self.token_bucket, row_multiple=self.row_multiple,
+            )
+        else:
+            batch = self.featurizer.featurize_batch(
+                statuses, row_bucket=self.row_bucket,
+                token_bucket=self.token_bucket,
+                row_multiple=self.row_multiple,
+            )
         for fn in self._outputs:
             fn(batch, batch_time)
         return batch
@@ -100,6 +113,7 @@ class StreamingContext:
         row_bucket: int = 0,
         token_bucket: int = 0,
         row_multiple: int = 1,
+        device_hash: bool = False,
     ) -> FeatureStream:
         """Attach the (single) source and build its feature stream —
         equivalent of TwitterUtils.createStream().filter().map().cache()
@@ -107,7 +121,9 @@ class StreamingContext:
         if self._source is not None:
             raise ValueError("StreamingContext supports one source stream")
         self._source = source
-        self._stream = FeatureStream(featurizer, row_bucket, token_bucket, row_multiple)
+        self._stream = FeatureStream(
+            featurizer, row_bucket, token_bucket, row_multiple, device_hash
+        )
         return self._stream
 
     def raw_stream(self, source: Source) -> RawStream:
