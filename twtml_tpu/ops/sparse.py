@@ -11,8 +11,11 @@ are two regimes:
 - **sparse path** (numTextFeatures = 2^18, BASELINE config #4): the dense
   matrix would be ~1GB of mostly zeros; instead predictions gather weight
   entries (w[token_idx]·token_val) and gradients scatter-add residuals with
-  one ``segment_sum`` per iteration. A pallas TPU kernel for this fused
-  gather/scatter lives in ops/pallas_sparse.py.
+  one ``segment_sum`` per iteration.
+
+Token pairs arrive either host-hashed (features/hashing.py, native/) or are
+computed in-program from raw code units (ops/text_hash.py — the default
+wire format); both feed these same kernels.
 
 Padded token slots carry (idx=0, val=0.0) so they contribute nothing to
 either path.
