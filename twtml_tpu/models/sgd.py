@@ -359,7 +359,7 @@ class StreamingSGDModel:
 
         return np.asarray(self._weights)
 
-    def step(self, batch: FeatureBatch) -> StepOutput:
+    def step(self, batch: FeatureBatch | UnitBatch) -> StepOutput:
         """Fused predict-then-train on one micro-batch; advances the model."""
         self._weights, out = self._step(self._weights, batch)
         return out
