@@ -26,12 +26,21 @@ sys.path.insert(0, REPO)
 N_TWEETS = 65536
 BATCH = 2048
 WARMUP_BATCHES = 2
-REPEATS = 6  # best-of — passes are ~0.3 s, transport stalls come in
-# multi-second bursts, so more short passes = better odds of a clean window
+# best-of with a time budget: passes are ~0.06 s, but transport stalls come
+# in bursts up to minutes long — keep sampling until the best has settled
+# (8 consecutive non-improving passes) or the budget runs out, so a stall
+# window at the wrong moment can't masquerade as the sustained rate
+REPEATS = 6
+TIME_BUDGET_S = 150.0
+SETTLED_AFTER = 8
 
 
 def measure(
-    n_tweets: int = N_TWEETS, batch_size: int = BATCH, repeats: int = REPEATS
+    n_tweets: int = N_TWEETS,
+    batch_size: int = BATCH,
+    repeats: int = REPEATS,
+    time_budget_s: float | None = TIME_BUDGET_S,
+    settled_after: int = SETTLED_AFTER,
 ) -> dict:
     import numpy as np  # noqa: F401
 
@@ -56,7 +65,8 @@ def measure(
         )
 
     out = measure_pipeline(
-        model, featurize, chunks, warmup_steps=WARMUP_BATCHES, repeats=repeats
+        model, featurize, chunks, warmup_steps=WARMUP_BATCHES, repeats=repeats,
+        time_budget_s=time_budget_s, settled_after=settled_after,
     )
     del out["batches"]
     return out
@@ -88,7 +98,10 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        print(json.dumps(measure(n_tweets=4096, repeats=2)))
+        # no transport jitter on the host backend: two plain passes suffice
+        print(json.dumps(
+            measure(n_tweets=4096, repeats=2, time_budget_s=None)
+        ))
         return
     if child == "device":
         print(json.dumps(measure()))
@@ -96,8 +109,9 @@ def main() -> None:
 
     # device measurement with a watchdog (TWTML_BENCH_TIMEOUT seconds):
     # a dead TPU tunnel yields a CPU-fallback record instead of a hang and
-    # no record at all. Healthy run ≈ compile (20-40 s) + 6×~0.3 s passes; the
-    # margin covers a degraded-but-alive tunnel without tripping on it.
+    # no record at all. Healthy run ≈ compile (20-40 s) + a pass loop that may
+    # legitimately spend up to TIME_BUDGET_S (150 s) riding out transport
+    # stalls; the margin above that covers a degraded-but-alive tunnel.
     timeout = float(os.environ.get("TWTML_BENCH_TIMEOUT", "1200"))
     device_result, device_err = _run_child("device", timeout)
     cpu_result, cpu_err = _run_child("cpu", timeout)

@@ -125,3 +125,36 @@ def test_e2e_linear_app_on_replay(capsys):
     out = capsys.readouterr().out
     assert "count: 6" in out
     assert "mse:" in out
+
+
+def test_feature_stream_device_hash_wire_format():
+    """device_hash=True (the apps' default via --hashOn device) ships
+    UnitBatches through the scheduler; stats surface matches host hashing."""
+    from twtml_tpu.features.batch import UnitBatch
+
+    results = {}
+    for device_hash in (False, True):
+        src = QueueSource()
+        ssc = StreamingContext(batch_interval=0.05)
+        feat = Featurizer(now_ms=0)
+        batches = []
+        ssc.source_stream(src, feat, device_hash=device_hash).foreach_batch(
+            lambda b, t: batches.append(b)
+        )
+        for lab in (150, 300, 700):
+            src.push(rt(label=lab, text=f"tweet number {lab}"))
+        src.close()
+        ssc.start()
+        ssc.await_termination(timeout=2)
+        ssc.stop()
+        assert sum(b.num_valid for b in batches) == 3
+        results[device_hash] = batches
+    assert all(isinstance(b, UnitBatch) for b in results[True])
+
+    def labels(batches):
+        return sorted(
+            float(l) for b in batches for l in b.label[b.mask.astype(bool)]
+        )
+
+    assert labels(results[True]) == [150.0, 300.0, 700.0]
+    assert labels(results[False]) == labels(results[True])
