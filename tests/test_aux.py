@@ -134,3 +134,63 @@ class TestTracer:
             (jnp.arange(8.0) * 2).block_until_ready()
         produced = list(tmp_path.rglob("*"))
         assert produced, "no trace files written"
+
+
+class TestLightningClient:
+    """Protocol-level tests of the Lightning REST client (telemetry/
+    lightning.py) against an in-process capture server — the vendored
+    lightning-scala jar's API surface incl. the scatter-streaming chart the
+    reference sketches at KMeans.scala:89,129-132."""
+
+    @pytest.fixture()
+    def server(self):
+        import http.server
+        import json as _json
+        import threading
+
+        calls = []
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(int(self.headers.get("content-length", 0)))
+                calls.append((self.path, _json.loads(body or b"{}")))
+                self.send_response(200)
+                self.send_header("content-type", "application/json")
+                self.end_headers()
+                self.wfile.write(b'{"id": "42"}')
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        yield f"http://127.0.0.1:{srv.server_port}", calls
+        srv.shutdown()
+
+    def test_line_streaming_create_and_append(self, server):
+        from twtml_tpu.telemetry.lightning import Lightning
+
+        host, calls = server
+        lgn = Lightning(host=host)
+        viz = lgn.line_streaming([[0.0]] * 2, size=[1.0, 2.0])
+        assert viz.id == "42"
+        assert calls[0][0] == "/sessions/"
+        assert calls[1][0] == "/sessions/42/visualizations/"
+        assert calls[1][1]["type"] == "line-streaming"
+        assert calls[1][1]["data"]["size"] == [1.0, 2.0]
+        lgn.line_streaming([[1.0], [2.0]], viz=viz)
+        assert calls[2][0] == "/visualizations/42/data/"
+        assert calls[2][1]["data"]["series"] == [[1.0], [2.0]]
+
+    def test_scatter_streaming_create_and_append(self, server):
+        from twtml_tpu.telemetry.lightning import Lightning
+
+        host, calls = server
+        lgn = Lightning(host=host)
+        viz = lgn.scatter_streaming([], [])
+        assert calls[-1][1]["type"] == "scatter-streaming"
+        lgn.scatter_streaming([1.0, 2.0], [3.0, 4.0], label=[0, 1], viz=viz)
+        path, payload = calls[-1]
+        assert path == "/visualizations/42/data/"
+        assert payload["data"] == {"x": [1.0, 2.0], "y": [3.0, 4.0], "label": [0, 1]}

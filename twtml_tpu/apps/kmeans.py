@@ -6,8 +6,11 @@ dense pair (original's retweetCount, original's followersCount)
 (KMeans.scala:19-33), per-batch StandardScaler(false, true), manual
 ``update(scaled, decayFactor, timeUnit)`` on a k=3 half-life-5-batches model
 with random 2-d centers (KMeans.scala:69-73,103-105), then per-batch debug
-output of centers and assignments (the reference's charts are all commented
-out, KMeans.scala:115-133 — we print the same values it logs).
+output of centers and assignments (KMeans.scala:118-127). The live cluster
+scatter chart the reference sketches and leaves commented out
+(KMeans.scala:89,129-132) is implemented here: per-batch points + predicted
+cluster labels stream to a Lightning scatter viz, best-effort like all
+telemetry.
 
 Run: ``python -m twtml_tpu.apps.kmeans --source replay --replayFile ...``
 """
@@ -33,6 +36,7 @@ log = get_logger("apps.kmeans")
 
 NUM_DIMENSIONS = 2  # KMeans.scala:57
 NUM_CLUSTERS = 3  # KMeans.scala:58
+SCATTER_MAX_POINTS = 200  # per-batch chart upload cap (telemetry, not math)
 
 
 def featurize(status: Status) -> np.ndarray:
@@ -48,6 +52,31 @@ def featurize(status: Status) -> np.ndarray:
 def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> dict:
     select_backend(conf)
     source: Source = build_source(conf)
+
+    # the scatter chart KMeans.scala:86-96 sets up (and :129-132 appends to,
+    # commented out there) — best-effort, training survives telemetry
+    # outages. Created on a daemon thread: urlopen's timeout doesn't bound
+    # DNS resolution, and startup must not stall on an unreachable resolver.
+    import threading
+
+    from ..telemetry.lightning import Lightning
+
+    lgn = Lightning(host=conf.lightning)
+    chart: dict = {}
+
+    def _open_chart() -> None:
+        try:
+            lgn.create_session(conf.appName())
+            viz = lgn.scatter_streaming([], [])
+            log.info(
+                "lightning cluster chart: %s/visualizations/%s",
+                conf.lightning, viz.id,
+            )
+            chart["viz"] = viz
+        except Exception as exc:
+            log.warning("lightning unavailable (%s); cluster chart disabled", exc)
+
+    threading.Thread(target=_open_chart, daemon=True).start()
 
     model = (
         StreamingKMeans()
@@ -85,6 +114,17 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
             flush=True,
         )
         log.debug("assignments: %s", assign.tolist())
+        viz = chart.get("viz")
+        if viz is not None:
+            # subsample like session_stats.py: don't pay a multi-MB JSON
+            # encode + POST per batch at bench-scale batch sizes
+            m = min(n, SCATTER_MAX_POINTS)
+            try:
+                lgn.scatter_streaming(
+                    scaled[:m, 0], scaled[:m, 1], label=pred[:m], viz=viz
+                )
+            except Exception as exc:
+                log.debug("lightning append failed (%s)", exc)
         if max_batches and totals["batches"] >= max_batches:
             ssc.request_stop()
 

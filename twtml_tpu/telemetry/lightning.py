@@ -1,13 +1,16 @@
-"""Lightning visualization-server client (line-streaming subset).
+"""Lightning visualization-server client (streaming-chart subset).
 
 Replaces the vendored lightning-scala jar (spark/lib/lightning-scala_2.10-*.jar).
-Only the API surface the reference actually uses is implemented
-(SessionStats.scala:11,31-33,49-52 and KMeans.scala:86-87):
+The API surface covers what the reference uses plus what it sketched and
+left commented out (SessionStats.scala:11,31-33,49-52; KMeans.scala:86-96,
+129-132):
 
 - ``Lightning(host)`` with lazy session creation (``create_session``);
 - ``line_streaming(series, size=None, color=None)`` → new ``Visualization``
   (type ``line-streaming``) seeded with the given series;
-- ``line_streaming(series, viz=viz)`` → append data to the live chart.
+- ``line_streaming(series, viz=viz)`` → append data to the live chart;
+- ``scatter_streaming(x, y, label=None[, viz=viz])`` — the k-means cluster
+  chart the reference's KMeans.scala:89,129-132 calls for but never enables.
 
 Endpoints follow the public Lightning REST protocol: ``POST /sessions/``,
 ``POST /sessions/{id}/visualizations/``, ``POST /visualizations/{id}/data/``.
@@ -52,6 +55,25 @@ class Lightning:
         self.session = str(out.get("id", ""))
         return self.session
 
+    def _create_or_append(
+        self, viz_type: str, data: dict, viz: Visualization | None
+    ) -> Visualization:
+        """Shared streaming-chart flow: viz=None creates a visualization of
+        ``viz_type`` seeded with ``data`` (lazily creating the session);
+        otherwise appends ``data`` to the live chart."""
+        if viz is None:
+            if not self.session:
+                self.create_session()
+            out = self._post(
+                f"/sessions/{self.session}/visualizations/",
+                {"type": viz_type, "data": data},
+            )
+            return Visualization(
+                id=str(out.get("id", "")), session=self.session, host=self.host
+            )
+        self._post(f"/visualizations/{viz.id}/data/", {"data": data})
+        return viz
+
     def line_streaming(
         self,
         series,
@@ -67,13 +89,20 @@ class Lightning:
             data["size"] = list(map(float, size))
         if color is not None:
             data["color"] = [list(map(float, c)) for c in color]
-        if viz is None:
-            if not self.session:
-                self.create_session()
-            out = self._post(
-                f"/sessions/{self.session}/visualizations/",
-                {"type": "line-streaming", "data": data},
-            )
-            return Visualization(id=str(out.get("id", "")), session=self.session, host=self.host)
-        self._post(f"/visualizations/{viz.id}/data/", {"data": data})
-        return viz
+        return self._create_or_append("line-streaming", data, viz)
+
+    def scatter_streaming(
+        self,
+        x,
+        y,
+        label=None,
+        viz: Visualization | None = None,
+    ) -> Visualization:
+        """Create (viz=None) or append to a streaming scatter plot — the
+        lightning-scala ``scatterstreaming`` the reference's k-means entry
+        sketches at KMeans.scala:89 (create) and :129-132 (append, with
+        per-point cluster labels)."""
+        data: dict = {"x": list(map(float, x)), "y": list(map(float, y))}
+        if label is not None:
+            data["label"] = list(map(int, label))
+        return self._create_or_append("scatter-streaming", data, viz)
