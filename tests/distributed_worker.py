@@ -1,0 +1,70 @@
+"""Worker process for the multi-host (jax.distributed) integration test.
+
+Not a test module — launched by tests/test_distributed_multiprocess.py, two
+processes forming a process group over localhost (gloo CPU collectives, 2
+virtual devices each = 4 global). Each worker featurizes its shard of the
+stream (the per-host sharded intake of SURVEY.md §7 stage 5), contributes
+its rows to the global batch via host_local_batch_to_global, and runs one
+mesh-sharded training step. Prints one JSON line with the step stats and
+final weights.
+
+Usage: python tests/distributed_worker.py <process_id> <num_processes> \
+           <coordinator_port> <wire_format: unit|host>
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.config.update("jax_num_cpu_devices", 2)
+
+
+def main() -> None:
+    pid, nprocs, port, wire = (
+        int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    )
+    jax.distributed.initialize(
+        f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid
+    )
+
+    import numpy as np
+
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.parallel import ParallelSGDModel, make_mesh
+    from twtml_tpu.parallel.distributed import host_local_batch_to_global
+    from twtml_tpu.streaming.sources import SyntheticSource
+
+    statuses = list(SyntheticSource(total=64, seed=7).produce())
+    local = statuses[pid::nprocs]  # this host's stream shard
+    feat = Featurizer(now_ms=1785320000000)
+    if wire == "unit":
+        batch = feat.featurize_batch_units(
+            local, row_bucket=16, unit_bucket=64, pre_filtered=True
+        )
+    else:
+        batch = feat.featurize_batch(
+            local, row_bucket=16, token_bucket=64, pre_filtered=True
+        )
+
+    mesh = make_mesh(num_data=len(jax.devices()), devices=jax.devices())
+    global_batch = host_local_batch_to_global(batch, mesh)
+    model = ParallelSGDModel(mesh, num_iterations=5, step_size=0.005)
+    out = model.step(global_batch)
+    print(json.dumps({
+        "process": pid,
+        "count": float(out.count),
+        "mse": float(out.mse),
+        "weights": np.asarray(model.latest_weights).tolist(),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -17,7 +17,9 @@ Run: ``python -m twtml_tpu.apps.kmeans --source replay --replayFile ...``
 
 from __future__ import annotations
 
+import queue
 import sys
+import threading
 
 import jax
 import numpy as np
@@ -29,6 +31,7 @@ from ..models.kmeans import StreamingKMeans
 from ..ops.scaler import standard_scale
 from ..streaming.context import StreamingContext
 from ..streaming.sources import Source
+from ..telemetry.lightning import CHART_MAX_POINTS, Lightning
 from ..utils import get_logger
 from .linear_regression import build_source, select_backend
 
@@ -36,7 +39,41 @@ log = get_logger("apps.kmeans")
 
 NUM_DIMENSIONS = 2  # KMeans.scala:57
 NUM_CLUSTERS = 3  # KMeans.scala:58
-SCATTER_MAX_POINTS = 200  # per-batch chart upload cap (telemetry, not math)
+CHART_FAILURE_LIMIT = 5  # consecutive append failures before giving up
+
+
+def _start_chart_worker(conf) -> "queue.Queue":
+    """Daemon thread owning every Lightning call for the cluster chart.
+    Returns the frame queue (drop-oldest, depth 2); the worker creates the
+    session + scatter viz, then streams frames, giving up for good after
+    CHART_FAILURE_LIMIT consecutive failures."""
+    q: "queue.Queue" = queue.Queue(maxsize=2)
+
+    def _worker() -> None:
+        try:
+            lgn = Lightning(host=conf.lightning)
+            lgn.create_session(conf.appName())
+            viz = lgn.scatter_streaming([], [])
+            log.info(
+                "lightning cluster chart: %s/visualizations/%s",
+                conf.lightning, viz.id,
+            )
+        except Exception as exc:
+            log.warning("lightning unavailable (%s); cluster chart disabled", exc)
+            return
+        failures = 0
+        while failures < CHART_FAILURE_LIMIT:
+            x, y, label = q.get()
+            try:
+                lgn.scatter_streaming(x, y, label=label, viz=viz)
+                failures = 0
+            except Exception as exc:
+                failures += 1
+                log.debug("lightning append failed (%s)", exc)
+        log.warning("cluster chart disabled after repeated append failures")
+
+    threading.Thread(target=_worker, daemon=True).start()
+    return q
 
 
 def featurize(status: Status) -> np.ndarray:
@@ -55,28 +92,11 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
 
     # the scatter chart KMeans.scala:86-96 sets up (and :129-132 appends to,
     # commented out there) — best-effort, training survives telemetry
-    # outages. Created on a daemon thread: urlopen's timeout doesn't bound
-    # DNS resolution, and startup must not stall on an unreachable resolver.
-    import threading
-
-    from ..telemetry.lightning import Lightning
-
-    lgn = Lightning(host=conf.lightning)
-    chart: dict = {}
-
-    def _open_chart() -> None:
-        try:
-            lgn.create_session(conf.appName())
-            viz = lgn.scatter_streaming([], [])
-            log.info(
-                "lightning cluster chart: %s/visualizations/%s",
-                conf.lightning, viz.id,
-            )
-            chart["viz"] = viz
-        except Exception as exc:
-            log.warning("lightning unavailable (%s); cluster chart disabled", exc)
-
-    threading.Thread(target=_open_chart, daemon=True).start()
+    # outages. ALL chart network IO (create + per-batch appends) lives on one
+    # daemon thread behind a drop-oldest queue: urlopen's timeout doesn't
+    # bound DNS resolution, so neither startup nor the batch loop may ever
+    # wait on the resolver; a slow chart just skips frames.
+    chart_q = _start_chart_worker(conf)
 
     model = (
         StreamingKMeans()
@@ -114,17 +134,14 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
             flush=True,
         )
         log.debug("assignments: %s", assign.tolist())
-        viz = chart.get("viz")
-        if viz is not None:
-            # subsample like session_stats.py: don't pay a multi-MB JSON
-            # encode + POST per batch at bench-scale batch sizes
-            m = min(n, SCATTER_MAX_POINTS)
-            try:
-                lgn.scatter_streaming(
-                    scaled[:m, 0], scaled[:m, 1], label=pred[:m], viz=viz
-                )
-            except Exception as exc:
-                log.debug("lightning append failed (%s)", exc)
+        # subsample like session_stats.py: don't pay a multi-MB JSON encode
+        # per batch at bench-scale batch sizes; drop the frame if the chart
+        # worker is behind (latest batch wins)
+        m = min(n, CHART_MAX_POINTS)
+        try:
+            chart_q.put_nowait((scaled[:m, 0], scaled[:m, 1], pred[:m]))
+        except queue.Full:
+            pass
         if max_batches and totals["batches"] >= max_batches:
             ssc.request_stop()
 

@@ -24,6 +24,10 @@ import json
 import urllib.request
 from dataclasses import dataclass, field
 
+# per-batch cap on chart points shipped to any streaming chart — huge
+# bench-scale batches are subsampled before paying the JSON encode
+CHART_MAX_POINTS = 200
+
 
 @dataclass
 class Visualization:

@@ -12,13 +12,14 @@ from __future__ import annotations
 import numpy as np
 
 from ..utils import get_logger, round_half_up
-from .lightning import Lightning, Visualization
+from .lightning import CHART_MAX_POINTS, Lightning, Visualization
 from .web_client import WebClient
 
 log = get_logger("telemetry.session")
 
-# per-batch cap on chart series points shipped to the dashboard
-SERIES_MAX_POINTS = 200
+# per-batch cap on chart series points shipped to the dashboard (shared
+# with every streaming chart — telemetry/lightning.py)
+SERIES_MAX_POINTS = CHART_MAX_POINTS
 
 # SessionStats.scala:15-20
 REAL_COLOR_DET = [173.0, 216.0, 230.0]  # light blue
