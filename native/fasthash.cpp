@@ -181,12 +181,16 @@ int32_t fasthash_batch(const uint16_t* units, const int64_t* offsets,
 // zero padding, plus per-row unit counts. Row-sliced memcpys beat numpy's
 // vectorized gather ~10x at tweet sizes. Rows in [batch, padded_rows) are
 // zeroed here too, so the caller can hand in uninitialized buffers.
+// ascii_lower != 0 folds 'A'-'Z' to lowercase during the copy: the Python
+// caller then only pays str.lower() for texts containing non-ASCII chars
+// (those are pre-lowered, and re-folding their ASCII range is idempotent).
 // Returns the maximum row length seen; the caller sized l_max from the same
 // offsets, so a return value > l_max means caller error (nothing truncated
 // silently — the rows are copied clamped but flagged by the return).
 int32_t pad_units_batch(const uint16_t* units, const int64_t* offsets,
                         int32_t batch, int32_t padded_rows, int32_t l_max,
-                        uint16_t* out_units, int32_t* out_len) {
+                        int32_t ascii_lower, uint16_t* out_units,
+                        int32_t* out_len) {
   int32_t max_len = 0;
   for (int32_t b = 0; b < batch; ++b) {
     const int64_t start = offsets[b];
@@ -194,7 +198,14 @@ int32_t pad_units_batch(const uint16_t* units, const int64_t* offsets,
     max_len = std::max(max_len, static_cast<int32_t>(len));
     const int64_t n = std::min<int64_t>(len, l_max);
     uint16_t* row = out_units + static_cast<int64_t>(b) * l_max;
-    std::memcpy(row, units + start, n * sizeof(uint16_t));
+    if (ascii_lower) {
+      for (int64_t i = 0; i < n; ++i) {
+        const uint16_t u = units[start + i];
+        row[i] = (u >= 'A' && u <= 'Z') ? u + 32 : u;
+      }
+    } else {
+      std::memcpy(row, units + start, n * sizeof(uint16_t));
+    }
     std::memset(row + n, 0, (l_max - n) * sizeof(uint16_t));
     out_len[b] = static_cast<int32_t>(n);
   }

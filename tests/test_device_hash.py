@@ -68,11 +68,17 @@ def _device_counts(text, num_features=1000):
         "café résumé",  # accents (hashed raw by default)
         "fire \U0001f525\U0001f525 alert",  # astral: surrogate-pair windows
         "\U0001f600",  # lone astral char: two units, one bigram
+        "BREAKING News!",  # ASCII case-folding happens in the C pad copy
+        "Füße WALKING",  # non-ASCII text: Python lower(), C fold idempotent
+        "İstanbul",  # U+0130 lowercases to 2 chars (length changes)
+        "ΣΙΓΜΑ",  # uppercase outside ASCII entirely
     ],
 )
 def test_device_hash_matches_ground_truth(text):
+    """Raw (unlowered) text through the Status-level API must hash exactly
+    like the ground truth over the lowercased text."""
     expected = hashing_tf_counts(char_bigrams(text.lower()), 1000)
-    assert _device_counts(text.lower()) == expected
+    assert _device_counts(text) == expected
 
 
 def test_unit_batch_densifies_identically(statuses, feat):
@@ -188,4 +194,15 @@ def test_sparse_path_accepts_unit_batches(statuses, feat):
     m_dev.step(big.featurize_batch_units(statuses))
     np.testing.assert_allclose(
         m_host.latest_weights, m_dev.latest_weights, rtol=1e-5, atol=1e-8
+    )
+
+
+def test_unit_batch_numpy_fallback_case_folds(monkeypatch):
+    """Without the C library the numpy pad path must fold ASCII case the
+    same way (C folds during the copy; numpy folds after the gather)."""
+    from twtml_tpu.features import native
+
+    monkeypatch.setattr(native, "pad_units", lambda *a, **k: None)
+    assert _device_counts("BREAKING News!") == hashing_tf_counts(
+        char_bigrams("breaking news!"), 1000
     )

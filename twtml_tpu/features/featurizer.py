@@ -307,9 +307,17 @@ class Featurizer:
 
         n = len(keep)
         originals = [s.retweeted_status for s in keep]
-        texts = [o.text.lower() for o in originals]
         if self.normalize_accents:
-            texts = [_strip_accents(t) for t in texts]
+            texts = [_strip_accents(o.text.lower()) for o in originals]
+        else:
+            # case-folding strategy: texts with non-ASCII chars need
+            # Python's Unicode lower(); pure-ASCII texts (the common case)
+            # are folded for free during the pad copy ('A'-'Z'+32, and
+            # re-folding the pre-lowered rows' ASCII range is idempotent)
+            texts = [
+                t if t.isascii() else t.lower()
+                for t in (o.text for o in originals)
+            ]
         units, offsets = native.encode_texts(texts)  # pure numpy, C-free
         lengths = np.diff(offsets).astype(np.int32)
         max_len = int(lengths.max()) if n else 0
@@ -320,7 +328,11 @@ class Featurizer:
             if unit_bucket >= max(max_len, 2) and unit_bucket > 0
             else _bucket(max(max_len, 2))
         )
-        padded = native.pad_units((units, offsets), n, b, lu) if n else None
+        padded = (
+            native.pad_units((units, offsets), n, b, lu, ascii_lower=True)
+            if n
+            else None
+        )
         if padded is not None:
             buf, length = padded
         else:
@@ -332,5 +344,7 @@ class Featurizer:
                 pos = offsets[:-1, None] + cols
                 buf[:n][valid] = units[pos[valid]]
                 length[:n] = lengths
+                upper = (buf >= 65) & (buf <= 90)
+                buf[upper] += 32
         numeric, label, mask = self._numeric_label_mask(keep, originals, b)
         return UnitBatch(buf, length, numeric, label, mask)

@@ -103,6 +103,7 @@ def _load(path: str) -> ctypes.CDLL:
         ctypes.c_int32,  # batch
         ctypes.c_int32,  # padded_rows
         ctypes.c_int32,  # l_max
+        ctypes.c_int32,  # ascii_lower
         ctypes.POINTER(ctypes.c_uint16),  # out_units
         ctypes.POINTER(ctypes.c_int32),  # out_len
     ]
@@ -153,10 +154,12 @@ def pad_units(
     n: int,
     padded_rows: int,
     l_max: int,
+    ascii_lower: bool = False,
 ) -> tuple[np.ndarray, np.ndarray] | None:
     """Ragged (units, offsets) → ([padded_rows, l_max] uint16, [padded_rows]
     int32 lengths) via the C row-memcpy loop; None if the library is
-    unavailable (caller falls back to the numpy gather)."""
+    unavailable (caller falls back to the numpy gather). ``ascii_lower``
+    folds 'A'-'Z' during the copy (see pad_units_batch)."""
     lib = get_lib()
     if lib is None:
         return None
@@ -169,6 +172,7 @@ def pad_units(
         n,
         padded_rows,
         l_max,
+        1 if ascii_lower else 0,
         buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
         length.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
