@@ -1,0 +1,489 @@
+// Native tweet-JSON block ingest — the framework's data-loader hot loop.
+//
+// The reference delegates ingestion to Twitter4j/Spark receivers (external
+// JVM dependencies, SURVEY.md §2.4); our replay/stream sources parse
+// newline-delimited tweet JSON. CPython json.loads + object assembly tops
+// out near ~90k tweets/s on one core — an order of magnitude below the
+// compute pipeline — so this parser extracts exactly the fields the
+// featurizer reads (MllibHelper.scala:42-95: the retweeted status' text,
+// retweet_count, user counts, timestamp) straight into columnar buffers,
+// applying the isRetweet + retweet-count-interval filter in-line
+// (MllibHelper.scala:89-95). Text is emitted as UTF-16-LE code units with
+// JSON escapes resolved (\uXXXX surrogate halves pass through exactly like
+// the JVM sees them), ready for the UnitBatch wire format (the device
+// hashes bigrams over these units — ops/text_hash.py).
+//
+// Only well-formed JSON is expected; a malformed line is skipped and
+// counted (callers surface the count). Semantic ground truth remains the
+// Python path (features/featurizer.py Status.from_json + filtrate +
+// featurize) — differential tests assert unit-for-unit equality.
+//
+// Build: compiled into libfasthash.so together with fasthash.cpp.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+struct Cursor {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  bool at_end() const { return p >= end; }
+  char peek() const { return at_end() ? '\0' : *p; }
+  void skip_ws() {
+    while (!at_end() && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+  bool eat(char c) {
+    skip_ws();
+    if (at_end() || *p != c) return false;
+    ++p;
+    return true;
+  }
+};
+
+// ---- string scanning ------------------------------------------------------
+
+inline int hex_val(char c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+  return -1;
+}
+
+// Scan a JSON string (cursor at opening quote). If out != nullptr, write
+// UTF-16 code units (escapes resolved, UTF-8 decoded) and return the unit
+// count via *n_units (buffer has cap units; overflow sets cur.ok = false).
+bool scan_string(Cursor& cur, uint16_t* out, int64_t cap, int64_t* n_units) {
+  if (!cur.eat('"')) return false;
+  int64_t n = 0;
+  auto emit = [&](uint32_t cp) {
+    if (out == nullptr) {
+      n += cp >= 0x10000 ? 2 : 1;
+      return;
+    }
+    if (cp >= 0x10000) {
+      if (n + 2 > cap) { cur.ok = false; return; }
+      cp -= 0x10000;
+      out[n++] = static_cast<uint16_t>(0xD800 + (cp >> 10));
+      out[n++] = static_cast<uint16_t>(0xDC00 + (cp & 0x3FF));
+    } else {
+      if (n + 1 > cap) { cur.ok = false; return; }
+      out[n++] = static_cast<uint16_t>(cp);
+    }
+  };
+  while (!cur.at_end() && cur.ok) {
+    unsigned char c = static_cast<unsigned char>(*cur.p);
+    if (c == '"') {
+      ++cur.p;
+      if (n_units) *n_units = n;
+      return true;
+    }
+    if (c == '\\') {
+      ++cur.p;
+      if (cur.at_end()) break;
+      char e = *cur.p++;
+      switch (e) {
+        case '"': emit('"'); break;
+        case '\\': emit('\\'); break;
+        case '/': emit('/'); break;
+        case 'b': emit('\b'); break;
+        case 'f': emit('\f'); break;
+        case 'n': emit('\n'); break;
+        case 'r': emit('\r'); break;
+        case 't': emit('\t'); break;
+        case 'u': {
+          if (cur.end - cur.p < 4) return false;
+          int v = 0;
+          for (int i = 0; i < 4; ++i) {
+            int h = hex_val(cur.p[i]);
+            if (h < 0) return false;
+            v = (v << 4) | h;
+          }
+          cur.p += 4;
+          // emit the unit as-is: surrogate halves stay halves, exactly the
+          // JVM's view of the string (features/hashing.py utf16_units)
+          if (out != nullptr) {
+            if (n + 1 > cap) { cur.ok = false; break; }
+            out[n++] = static_cast<uint16_t>(v);
+          } else {
+            n += 1;
+          }
+          break;
+        }
+        default: return false;
+      }
+      continue;
+    }
+    // UTF-8 decode (1-4 bytes) -> code point
+    uint32_t cp;
+    int extra;
+    if (c < 0x80) { cp = c; extra = 0; }
+    else if ((c >> 5) == 0x6) { cp = c & 0x1F; extra = 1; }
+    else if ((c >> 4) == 0xE) { cp = c & 0x0F; extra = 2; }
+    else if ((c >> 3) == 0x1E) { cp = c & 0x07; extra = 3; }
+    else return false;
+    if (cur.end - cur.p < extra + 1) return false;
+    for (int i = 1; i <= extra; ++i) {
+      unsigned char cc = static_cast<unsigned char>(cur.p[i]);
+      if ((cc >> 6) != 0x2) return false;
+      cp = (cp << 6) | (cc & 0x3F);
+    }
+    cur.p += extra + 1;
+    emit(cp);
+  }
+  return false;
+}
+
+// ---- generic value skipping ----------------------------------------------
+
+bool skip_value(Cursor& cur);
+
+bool skip_container(Cursor& cur, char open, char close) {
+  if (!cur.eat(open)) return false;
+  cur.skip_ws();
+  if (cur.peek() == close) { ++cur.p; return true; }
+  while (true) {
+    if (open == '{') {
+      if (!scan_string(cur, nullptr, 0, nullptr)) return false;
+      if (!cur.eat(':')) return false;
+    }
+    if (!skip_value(cur)) return false;
+    cur.skip_ws();
+    if (cur.peek() == ',') { ++cur.p; cur.skip_ws(); continue; }
+    if (cur.peek() == close) { ++cur.p; return true; }
+    return false;
+  }
+}
+
+bool skip_value(Cursor& cur) {
+  cur.skip_ws();
+  char c = cur.peek();
+  if (c == '"') return scan_string(cur, nullptr, 0, nullptr);
+  if (c == '{') return skip_container(cur, '{', '}');
+  if (c == '[') return skip_container(cur, '[', ']');
+  // number / true / false / null: scan to a structural delimiter
+  const char* start = cur.p;
+  while (!cur.at_end() && *cur.p != ',' && *cur.p != '}' && *cur.p != ']' &&
+         *cur.p != ' ' && *cur.p != '\t' && *cur.p != '\n' && *cur.p != '\r')
+    ++cur.p;
+  return cur.p > start;
+}
+
+// Parse an integer-valued JSON number (or a string wrapping one, Twitter's
+// "timestamp_ms"); fractional digits are truncated. Returns false on
+// non-numeric values (caller leaves the field at its default).
+bool parse_int(Cursor& cur, int64_t* out) {
+  cur.skip_ws();
+  bool quoted = cur.peek() == '"';
+  if (quoted) ++cur.p;
+  bool neg = false;
+  if (cur.peek() == '-') { neg = true; ++cur.p; }
+  if (cur.at_end() || *cur.p < '0' || *cur.p > '9') return false;
+  int64_t v = 0;
+  while (!cur.at_end() && *cur.p >= '0' && *cur.p <= '9')
+    v = v * 10 + (*cur.p++ - '0');
+  if (!cur.at_end() && *cur.p == '.') {  // truncate fraction
+    ++cur.p;
+    while (!cur.at_end() && *cur.p >= '0' && *cur.p <= '9') ++cur.p;
+  }
+  if (quoted && !cur.eat('"')) return false;
+  *out = neg ? -v : v;
+  return true;
+}
+
+// "Wed Aug 27 13:08:45 +0000 2008" -> epoch millis (0 on mismatch).
+int64_t parse_created_at(const uint16_t* u, int64_t n) {
+  if (n != 30) return 0;
+  char s[31];
+  for (int i = 0; i < 30; ++i) {
+    if (u[i] > 127) return 0;
+    s[i] = static_cast<char>(u[i]);
+  }
+  s[30] = '\0';
+  static const char* months = "JanFebMarAprMayJunJulAugSepOctNovDec";
+  int mon = -1;
+  for (int m = 0; m < 12; ++m)
+    if (std::memcmp(s + 4, months + m * 3, 3) == 0) { mon = m; break; }
+  if (mon < 0) return 0;
+  auto num = [&](int off, int len) {
+    int v = 0;
+    for (int i = 0; i < len; ++i) {
+      if (s[off + i] < '0' || s[off + i] > '9') return -1;
+      v = v * 10 + (s[off + i] - '0');
+    }
+    return v;
+  };
+  int day = num(8, 2), hh = num(11, 2), mm = num(14, 2), ss = num(17, 2);
+  int tz_h = num(21, 2), tz_m = num(23, 2), year = num(26, 4);
+  if (day < 0 || hh < 0 || mm < 0 || ss < 0 || tz_h < 0 || tz_m < 0 ||
+      year < 0 || (s[20] != '+' && s[20] != '-'))
+    return 0;
+  // days since epoch (civil calendar, Howard Hinnant's algorithm)
+  int y = year - (mon < 2 ? 1 : 0);
+  int era = (y >= 0 ? y : y - 399) / 400;
+  unsigned yoe = static_cast<unsigned>(y - era * 400);
+  unsigned m2 = static_cast<unsigned>(mon >= 2 ? mon - 2 : mon + 10);
+  unsigned doy = (153 * m2 + 2) / 5 + static_cast<unsigned>(day) - 1;
+  unsigned doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+  int64_t days = static_cast<int64_t>(era) * 146097 +
+                 static_cast<int64_t>(doe) - 719468;
+  int64_t secs = days * 86400 + hh * 3600 + mm * 60 + ss;
+  int64_t tz = (tz_h * 3600 + tz_m * 60);
+  secs -= (s[20] == '+') ? tz : -tz;
+  return secs * 1000;
+}
+
+struct RtFields {
+  // absent numeric fields default to 0, exactly like Status.from_json
+  int64_t retweet_count = 0;
+  int64_t followers = 0, favourites = 0, friends = 0, created_ms = 0;
+  int64_t text_units = 0;       // units written to the text buffer
+  int64_t full_text_units = 0;  // units written to the full_text buffer
+  bool present = false;
+};
+
+constexpr int64_t kMaxTextUnits = 4096;  // tweets cap well below this
+
+// Parse the retweeted_status object, extracting our fields. ``text_buf``
+// and ``full_buf`` each hold kMaxTextUnits; the caller picks text-or-
+// full_text afterwards (Status.from_json semantics: "text" wins unless
+// empty — extended-tweet archives store the body in "full_text").
+bool parse_rt_object(Cursor& cur, RtFields* rt, uint16_t* text_buf,
+                     uint16_t* full_buf) {
+  if (!cur.eat('{')) return false;
+  rt->present = true;
+  cur.skip_ws();
+  if (cur.peek() == '}') { ++cur.p; return true; }
+  uint16_t key[32];
+  while (true) {
+    int64_t klen = 0;
+    {
+      Cursor probe = cur;
+      if (!scan_string(probe, key, 32, &klen)) {
+        // long/unsupported key: skip it generically
+        if (!scan_string(cur, nullptr, 0, nullptr)) return false;
+        klen = -1;
+      } else {
+        cur = probe;
+      }
+    }
+    if (!cur.eat(':')) return false;
+    auto is_key = [&](const char* name) {
+      int64_t len = static_cast<int64_t>(std::strlen(name));
+      if (klen != len) return false;
+      for (int64_t i = 0; i < len; ++i)
+        if (key[i] != static_cast<uint16_t>(name[i])) return false;
+      return true;
+    };
+    if (klen > 0 && is_key("text")) {
+      cur.skip_ws();
+      if (cur.peek() == '"') {
+        if (!scan_string(cur, text_buf, kMaxTextUnits, &rt->text_units))
+          return false;
+      } else if (!skip_value(cur)) {
+        return false;
+      }
+    } else if (klen > 0 && is_key("full_text")) {
+      cur.skip_ws();
+      if (cur.peek() == '"') {
+        if (!scan_string(cur, full_buf, kMaxTextUnits, &rt->full_text_units))
+          return false;
+      } else if (!skip_value(cur)) {
+        return false;
+      }
+    } else if (klen > 0 && is_key("retweet_count")) {
+      if (!parse_int(cur, &rt->retweet_count)) {
+        if (!skip_value(cur)) return false;
+      }
+    } else if (klen > 0 && is_key("timestamp_ms")) {
+      int64_t v;
+      if (parse_int(cur, &v)) rt->created_ms = v;
+      else if (!skip_value(cur)) return false;
+    } else if (klen > 0 && is_key("created_at")) {
+      cur.skip_ws();
+      if (cur.peek() == '"') {
+        uint16_t date[40];
+        int64_t dn = 0;
+        if (!scan_string(cur, date, 40, &dn)) return false;
+        if (rt->created_ms == 0) rt->created_ms = parse_created_at(date, dn);
+      } else if (!skip_value(cur)) {
+        return false;
+      }
+    } else if (klen > 0 && is_key("user")) {
+      cur.skip_ws();
+      if (cur.peek() != '{') {
+        if (!skip_value(cur)) return false;
+      } else {
+        ++cur.p;
+        cur.skip_ws();
+        if (cur.peek() == '}') { ++cur.p; }
+        else while (true) {
+          int64_t uklen = 0;
+          uint16_t ukey[32];
+          Cursor probe = cur;
+          if (!scan_string(probe, ukey, 32, &uklen)) {
+            if (!scan_string(cur, nullptr, 0, nullptr)) return false;
+            uklen = -1;
+          } else {
+            cur = probe;
+          }
+          if (!cur.eat(':')) return false;
+          auto is_ukey = [&](const char* name) {
+            int64_t len = static_cast<int64_t>(std::strlen(name));
+            if (uklen != len) return false;
+            for (int64_t i = 0; i < len; ++i)
+              if (ukey[i] != static_cast<uint16_t>(name[i])) return false;
+            return true;
+          };
+          int64_t* dst = nullptr;
+          if (uklen > 0 && is_ukey("followers_count")) dst = &rt->followers;
+          else if (uklen > 0 && is_ukey("favourites_count")) dst = &rt->favourites;
+          else if (uklen > 0 && is_ukey("friends_count")) dst = &rt->friends;
+          if (dst != nullptr) {
+            if (!parse_int(cur, dst)) {
+              if (!skip_value(cur)) return false;
+            }
+          } else if (!skip_value(cur)) {
+            return false;
+          }
+          cur.skip_ws();
+          if (cur.peek() == ',') { ++cur.p; continue; }
+          if (cur.peek() == '}') { ++cur.p; break; }
+          return false;
+        }
+      }
+    } else if (!skip_value(cur)) {
+      return false;
+    }
+    cur.skip_ws();
+    if (cur.peek() == ',') { ++cur.p; cur.skip_ws(); continue; }
+    if (cur.peek() == '}') { ++cur.p; return true; }
+    return false;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse a block of newline-delimited tweet JSON, keeping only rows that
+// pass the reference filter (isRetweet && begin <= rt.retweet_count <= end,
+// MllibHelper.scala:89-95). Outputs, per kept row i:
+//   out_numeric[i*5 .. i*5+4] = {retweet_count (label), followers,
+//                                favourites, friends, created_ms}
+//   out_units[out_offsets[i] .. out_offsets[i+1]) = the original tweet's
+//     text as UTF-16 code units (escapes resolved; NOT lowercased — callers
+//     use the pad-time ASCII fold + Python lower for non-ASCII rows)
+//   out_ascii[i] = 1 when every unit < 128 (row skips Python lower())
+//
+// buf/len: UTF-8 bytes; rows split on '\n'. cap_rows/cap_units bound the
+// outputs; parsing stops early (cleanly) when either would overflow, and
+// *consumed reports how many input bytes were processed so the caller can
+// continue from there. Malformed lines are skipped and counted in
+// *bad_lines. Returns the number of kept rows.
+int64_t parse_tweet_block(const char* buf, int64_t len,
+                          int64_t begin, int64_t end,
+                          int64_t cap_rows, int64_t cap_units,
+                          int64_t* out_numeric, uint16_t* out_units,
+                          int64_t* out_offsets, uint8_t* out_ascii,
+                          int64_t* consumed, int64_t* bad_lines) {
+  int64_t rows = 0, unit_pos = 0, bad = 0;
+  const char* p = buf;
+  const char* block_end = buf + len;
+  out_offsets[0] = 0;
+  uint16_t text[kMaxTextUnits];
+  uint16_t full_text[kMaxTextUnits];
+  while (p < block_end) {
+    const char* nl = static_cast<const char*>(
+        std::memchr(p, '\n', block_end - p));
+    if (nl == nullptr) break;  // incomplete trailing line: leave for carry
+    const char* line_end = nl;
+    if (rows >= cap_rows || unit_pos + kMaxTextUnits > cap_units) break;
+    Cursor cur{p, line_end};
+    cur.skip_ws();
+    if (!cur.at_end()) {
+      RtFields rt;
+      bool parsed = false;
+      if (cur.eat('{')) {
+        cur.skip_ws();
+        parsed = true;
+        if (cur.peek() == '}') { ++cur.p; }
+        else while (true) {
+          uint16_t key[32];
+          int64_t klen = 0;
+          Cursor probe = cur;
+          if (!scan_string(probe, key, 32, &klen)) {
+            if (!scan_string(cur, nullptr, 0, nullptr)) { parsed = false; break; }
+            klen = -1;
+          } else {
+            cur = probe;
+          }
+          if (!cur.eat(':')) { parsed = false; break; }
+          bool is_rt_key = false;
+          if (klen == 16) {
+            static const char* name = "retweeted_status";
+            is_rt_key = true;
+            for (int i = 0; i < 16; ++i)
+              if (key[i] != static_cast<uint16_t>(name[i])) {
+                is_rt_key = false;
+                break;
+              }
+          }
+          if (is_rt_key) {
+            cur.skip_ws();
+            if (cur.peek() == '{') {
+              if (!parse_rt_object(cur, &rt, text, full_text)) {
+                parsed = false;
+                break;
+              }
+            } else if (!skip_value(cur)) {  // null and friends
+              parsed = false;
+              break;
+            }
+          } else if (!skip_value(cur)) {
+            parsed = false;
+            break;
+          }
+          cur.skip_ws();
+          if (cur.peek() == ',') { ++cur.p; cur.skip_ws(); continue; }
+          if (cur.peek() == '}') { ++cur.p; break; }
+          parsed = false;
+          break;
+        }
+      }
+      if (!parsed || !cur.ok) {
+        ++bad;
+      } else if (rt.present && rt.retweet_count >= begin &&
+                 rt.retweet_count <= end) {
+        int64_t* num = out_numeric + rows * 5;
+        num[0] = rt.retweet_count;
+        num[1] = rt.followers;
+        num[2] = rt.favourites;
+        num[3] = rt.friends;
+        num[4] = rt.created_ms;
+        // "text" wins unless empty, else "full_text" (Status.from_json)
+        const uint16_t* body = rt.text_units > 0 ? text : full_text;
+        const int64_t body_units =
+            rt.text_units > 0 ? rt.text_units : rt.full_text_units;
+        bool ascii = true;
+        for (int64_t i = 0; i < body_units; ++i) {
+          out_units[unit_pos + i] = body[i];
+          if (body[i] >= 128) ascii = false;
+        }
+        out_ascii[rows] = ascii ? 1 : 0;
+        unit_pos += body_units;
+        ++rows;
+        out_offsets[rows] = unit_pos;
+      }
+    }
+    p = nl + 1;
+  }
+  *consumed = p - buf;
+  *bad_lines = bad;
+  return rows;
+}
+
+}  // extern "C"

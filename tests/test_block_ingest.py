@@ -1,0 +1,256 @@
+"""Native block ingest (native/tweetjson.cpp + features/blocks.py) parity.
+
+The C data-loader must produce byte-identical batches to the Python
+ground-truth path (json.loads → Status → filtrate → featurize): same kept
+rows, same UTF-16 units (escapes, emoji, surrogates), same numerics and
+timestamps. Every test compares against the object path end to end.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from twtml_tpu.features import Featurizer, Status
+from twtml_tpu.features.blocks import merge_blocks
+from twtml_tpu.streaming.sources import BlockReplayFileSource
+
+DATA = os.path.join(os.path.dirname(__file__), "data", "tweets.jsonl")
+
+
+def _object_path_batch(path, feat, **kw):
+    with open(path, encoding="utf-8") as fh:
+        statuses = [Status.from_json(json.loads(l)) for l in fh if l.strip()]
+    return feat.featurize_batch_units(statuses, **kw)
+
+
+def _block_path_batch(path, feat, block_bytes=1 << 20, **kw):
+    src = BlockReplayFileSource(path, block_bytes=block_bytes)
+    blocks = list(src.produce())
+    assert blocks, "no blocks produced"
+    return feat.featurize_parsed_block(merge_blocks(blocks), **kw)
+
+
+def _assert_batches_equal(a, b):
+    assert type(a) is type(b)
+    np.testing.assert_array_equal(a.units, b.units)
+    np.testing.assert_array_equal(a.length, b.length)
+    np.testing.assert_allclose(a.numeric, b.numeric, rtol=1e-6)
+    np.testing.assert_array_equal(a.label, b.label)
+    np.testing.assert_array_equal(a.mask, b.mask)
+
+
+@pytest.fixture()
+def feat():
+    return Featurizer(now_ms=1785320000000)
+
+
+def test_fixture_file_parity(feat):
+    obj = _object_path_batch(DATA, feat, row_bucket=16, unit_bucket=128)
+    blk = _block_path_batch(DATA, feat, row_bucket=16, unit_bucket=128)
+    _assert_batches_equal(obj, blk)
+
+
+def test_fixture_file_parity_python_fallback(feat, monkeypatch):
+    from twtml_tpu.features import native
+
+    monkeypatch.setattr(native, "parse_tweet_block", lambda *a, **k: None)
+    obj = _object_path_batch(DATA, feat, row_bucket=16, unit_bucket=128)
+    blk = _block_path_batch(DATA, feat, row_bucket=16, unit_bucket=128)
+    _assert_batches_equal(obj, blk)
+
+
+def test_tiny_blocks_carry_across_chunk_boundaries(feat):
+    """block_bytes far smaller than a line forces the consumed/carry logic."""
+    obj = _object_path_batch(DATA, feat, row_bucket=16, unit_bucket=128)
+    blk = _block_path_batch(
+        DATA, feat, block_bytes=64, row_bucket=16, unit_bucket=128
+    )
+    _assert_batches_equal(obj, blk)
+
+
+ADVERSARIAL = [
+    # escapes incl. \uXXXX and an escaped surrogate pair (emoji)
+    {"text": "RT", "retweeted_status": {
+        "text": "line\\none \"q\" tab\\t \\u00e9 \\ud83d\\ude00 end",
+        "retweet_count": 150,
+        "user": {"followers_count": 1, "favourites_count": 2, "friends_count": 3},
+        "timestamp_ms": "1785310000000"}},
+    # raw UTF-8 emoji + CJK, extra nested structures to skip
+    {"text": "RT", "extended_entities": {"media": [{"sizes": {"h": 1}}]},
+     "retweeted_status": {
+        "text": "火 🔥 test",
+        "retweet_count": 999,
+        "entities": {"urls": [{"indices": [0, 1]}], "hashtags": []},
+        "user": {"followers_count": 7, "favourites_count": 0,
+                 "friends_count": 9, "description": "nested \"quotes\" {\\n}"},
+        "created_at": "Wed Aug 27 13:08:45 +0000 2008"}},
+    # boundary values: counts exactly at the [100, 1000] edges
+    {"text": "RT", "retweeted_status": {"text": "low edge", "retweet_count": 100,
+        "user": {"followers_count": 0, "favourites_count": 0, "friends_count": 0},
+        "timestamp_ms": "1785300000000"}},
+    {"text": "RT", "retweeted_status": {"text": "high edge", "retweet_count": 1000,
+        "user": {"followers_count": 0, "favourites_count": 0, "friends_count": 0},
+        "timestamp_ms": "1785300000000"}},
+    # filtered out: not a retweet / out of range / null retweeted_status
+    {"text": "plain tweet", "retweet_count": 500},
+    {"text": "RT", "retweeted_status": {"text": "too hot", "retweet_count": 99999,
+        "user": {}}},
+    {"text": "RT", "retweeted_status": None},
+    # numbers as floats, negative, booleans and nulls in skipped fields
+    {"text": "RT", "truncated": False, "coordinates": None,
+     "retweeted_status": {"text": "float counts", "retweet_count": 250.0,
+        "user": {"followers_count": 123.9, "favourites_count": -1,
+                 "friends_count": 0}, "timestamp_ms": 1785311111111}},
+    # empty text
+    {"text": "RT", "retweeted_status": {"text": "", "retweet_count": 500,
+        "user": {"followers_count": 5, "favourites_count": 5, "friends_count": 5},
+        "timestamp_ms": "1785312222222"}},
+]
+
+
+def test_adversarial_json_parity(feat, tmp_path):
+    path = tmp_path / "adversarial.jsonl"
+    path.write_text(
+        "\n".join(json.dumps(o) for o in ADVERSARIAL) + "\n", encoding="utf-8"
+    )
+    obj = _object_path_batch(str(path), feat, row_bucket=8, unit_bucket=64)
+    blk = _block_path_batch(str(path), feat, row_bucket=8, unit_bucket=64)
+    assert obj.num_valid == 6  # 4 escape/utf8/boundary + float counts + empty
+    _assert_batches_equal(obj, blk)
+
+
+def test_created_at_string_matches_python(feat, tmp_path):
+    """The C fixed-format date parse must agree with Python's strptime."""
+    path = tmp_path / "dates.jsonl"
+    obj = {"text": "RT", "retweeted_status": {
+        "text": "dated", "retweet_count": 300,
+        "user": {"followers_count": 1, "favourites_count": 1, "friends_count": 1},
+        "created_at": "Mon Feb 29 23:59:59 +0130 2016"}}
+    path.write_text(json.dumps(obj) + "\n", encoding="utf-8")
+    o = _object_path_batch(str(path), feat, row_bucket=8)
+    b = _block_path_batch(str(path), feat, row_bucket=8)
+    _assert_batches_equal(o, b)
+    assert o.numeric[0, 3] != 0  # age feature actually derived from the date
+
+
+def test_malformed_lines_skipped(feat, tmp_path):
+    path = tmp_path / "bad.jsonl"
+    good = {"text": "RT", "retweeted_status": {"text": "ok", "retweet_count": 500,
+            "user": {"followers_count": 1, "favourites_count": 1,
+                     "friends_count": 1}, "timestamp_ms": "1785313333333"}}
+    path.write_text(
+        json.dumps(good) + "\n" + "{not json}\n" + json.dumps(good) + "\n",
+        encoding="utf-8",
+    )
+    blk = _block_path_batch(str(path), feat, row_bucket=8)
+    assert blk.num_valid == 2
+
+
+def test_linear_app_block_ingest_matches_object(tmp_path, capsys):
+    """End to end through the CLI run(): --ingest block == --ingest object."""
+    from twtml_tpu.apps import linear_regression as app
+    from twtml_tpu.config import ConfArguments
+
+    outputs = {}
+    for ingest in ("object", "block"):
+        conf = ConfArguments().parse([
+            "--source", "replay", "--replayFile", DATA, "--ingest", ingest,
+            "--lightning", "http://127.0.0.1:9", "--twtweb", "http://127.0.0.1:9",
+            "--backend", "cpu",
+        ])
+        app.run(conf, max_batches=1)
+        outputs[ingest] = [
+            l for l in capsys.readouterr().out.splitlines()
+            if l.startswith("count:")
+        ]
+    assert outputs["block"] == outputs["object"]
+    assert outputs["block"], "no stats lines captured"
+
+
+def test_full_text_extended_tweets_parity(feat, tmp_path):
+    """Extended-tweet archives store the body in full_text (no text key)."""
+    path = tmp_path / "extended.jsonl"
+    objs = [
+        {"text": "RT", "retweeted_status": {
+            "full_text": "the entire extended tweet body, uncut",
+            "retweet_count": 400,
+            "user": {"followers_count": 2, "favourites_count": 2,
+                     "friends_count": 2}, "timestamp_ms": "1785314444444"}},
+        # empty text falls through to full_text, like Status.from_json
+        {"text": "RT", "retweeted_status": {
+            "text": "", "full_text": "fallback body", "retweet_count": 500,
+            "user": {"followers_count": 1, "favourites_count": 1,
+                     "friends_count": 1}, "timestamp_ms": "1785315555555"}},
+        # text wins over full_text when non-empty
+        {"text": "RT", "retweeted_status": {
+            "text": "short form", "full_text": "long form", "retweet_count": 600,
+            "user": {"followers_count": 1, "favourites_count": 1,
+                     "friends_count": 1}, "timestamp_ms": "1785316666666"}},
+    ]
+    path.write_text("\n".join(json.dumps(o) for o in objs) + "\n", "utf-8")
+    obj = _object_path_batch(str(path), feat, row_bucket=8, unit_bucket=64)
+    blk = _block_path_batch(str(path), feat, row_bucket=8, unit_bucket=64)
+    assert obj.num_valid == 3
+    _assert_batches_equal(obj, blk)
+
+
+def test_missing_retweet_count_with_zero_begin(tmp_path):
+    """Absent retweet_count coerces to 0 in BOTH paths (Status.from_json
+    semantics), so numRetweetBegin=0 keeps the row in both modes."""
+    feat0 = Featurizer(now_ms=1785320000000, num_retweet_begin=0)
+    path = tmp_path / "nocount.jsonl"
+    obj = {"text": "RT", "retweeted_status": {
+        "text": "countless", "user": {"followers_count": 1,
+        "favourites_count": 1, "friends_count": 1},
+        "timestamp_ms": "1785317777777"}}
+    path.write_text(json.dumps(obj) + "\n", "utf-8")
+    o = _object_path_batch(str(path), feat0, row_bucket=8)
+    src = BlockReplayFileSource(str(path), num_retweet_begin=0)
+    blocks = list(src.produce())
+    b = feat0.featurize_parsed_block(merge_blocks(blocks), row_bucket=8)
+    assert o.num_valid == 1
+    _assert_batches_equal(o, b)
+
+
+def test_py_fallback_skips_non_object_json(feat, tmp_path, monkeypatch):
+    """Valid JSON that isn't a tweet object must skip, not crash, in the
+    Python fallback — matching the C parser's bad-line contract."""
+    from twtml_tpu.features import native
+
+    monkeypatch.setattr(native, "parse_tweet_block", lambda *a, **k: None)
+    path = tmp_path / "nonobj.jsonl"
+    good = {"text": "RT", "retweeted_status": {"text": "ok", "retweet_count": 500,
+            "user": {"followers_count": 1, "favourites_count": 1,
+                     "friends_count": 1}, "timestamp_ms": "1785318888888"}}
+    path.write_text(
+        "[1, 2]\n" + json.dumps(good) + "\n\"str\"\n5\n" + json.dumps(good) + "\n",
+        encoding="utf-8",
+    )
+    blk = _block_path_batch(str(path), feat, row_bucket=8)
+    assert blk.num_valid == 2
+
+
+def test_block_ingest_rejected_outside_linear_app(tmp_path):
+    from twtml_tpu.apps.linear_regression import build_source
+    from twtml_tpu.config import ConfArguments
+
+    conf = ConfArguments().parse(
+        ["--source", "replay", "--replayFile", DATA, "--ingest", "block"]
+    )
+    with pytest.raises(SystemExit):
+        build_source(conf)  # kmeans/logistic call without allow_block
+    assert build_source(conf, allow_block=True) is not None
+
+
+def test_block_ingest_rejects_host_hashing():
+    from twtml_tpu.apps.linear_regression import build_source
+    from twtml_tpu.config import ConfArguments
+
+    conf = ConfArguments().parse([
+        "--source", "replay", "--replayFile", DATA,
+        "--ingest", "block", "--hashOn", "host",
+    ])
+    with pytest.raises(SystemExit):
+        build_source(conf, allow_block=True)

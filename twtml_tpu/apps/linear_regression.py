@@ -58,11 +58,36 @@ def select_backend(conf) -> None:
             raise RuntimeError("--backend tpu requested but only CPU devices present")
 
 
-def build_source(conf) -> Source:
+def build_source(conf, allow_block: bool = False) -> Source:
+    if conf.ingest == "block" and not allow_block:
+        # only the linear app's pipeline consumes ParsedBlocks (k-means
+        # featurizes Status pairs; logistic needs label_fn over Status)
+        raise SystemExit("--ingest block is only supported by the linear app")
+    if conf.ingest == "block" and conf.source != "replay":
+        raise SystemExit("--ingest block requires --source replay")
     if conf.source == "replay":
         if not conf.replayFile:
             raise SystemExit("--source replay requires --replayFile <path.jsonl>")
-        source: Source = ReplayFileSource(conf.replayFile, speed=conf.replaySpeed)
+        if conf.ingest == "block":
+            from ..streaming.sources import BlockReplayFileSource
+
+            if conf.replaySpeed:
+                raise SystemExit(
+                    "--ingest block replays as fast as possible; "
+                    "drop --replaySpeed or use --ingest object"
+                )
+            if conf.hashOn != "device":
+                raise SystemExit(
+                    "--ingest block ships raw code units (device hashing); "
+                    "--hashOn host requires --ingest object"
+                )
+            source: Source = BlockReplayFileSource(
+                conf.replayFile,
+                num_retweet_begin=conf.numRetweetBegin,
+                num_retweet_end=conf.numRetweetEnd,
+            )
+            return _wrap_faults(source, conf)
+        source = ReplayFileSource(conf.replayFile, speed=conf.replaySpeed)
     elif conf.source == "synthetic":
         source = SyntheticSource(rate=conf.replaySpeed or 0.0)
     elif conf.source == "twitter":
@@ -71,6 +96,10 @@ def build_source(conf) -> Source:
         source = TwitterSource.from_properties()
     else:
         raise SystemExit(f"unknown --source {conf.source!r}")
+    return _wrap_faults(source, conf)
+
+
+def _wrap_faults(source: Source, conf) -> Source:
     if conf.faultEvery > 0:
         from ..streaming.faults import FaultInjectingSource
 
@@ -115,7 +144,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     log.info("Initializing streaming context... %s sec/batch", conf.seconds)
     ssc = StreamingContext(batch_interval=conf.seconds)
     stream = ssc.source_stream(
-        build_source(conf), featurizer,
+        build_source(conf, allow_block=True), featurizer,
         row_bucket=conf.batchBucket, row_multiple=row_multiple,
         device_hash=conf.hashOn == "device",
     )

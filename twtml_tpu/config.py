@@ -120,6 +120,11 @@ class ConfArguments:
             raise ValueError(
                 f"hashOn must be 'device' or 'host', got {self.hashOn!r}"
             )
+        self.ingest: str = conf.get("ingest", "object")
+        if self.ingest not in ("object", "block"):
+            raise ValueError(
+                f"ingest must be 'object' or 'block', got {self.ingest!r}"
+            )
         self.l2Reg: float = float(conf.get("l2Reg", "0.0"))
         self.convergenceTol: float = float(conf.get("convergenceTol", "0.001"))
         self.dtype: str = conf.get("dtype", "float32")
@@ -182,6 +187,9 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
   --hashOn <device|host>                       Bigram-hash featurization inside the XLA step
                                                (device, default) or on the host CPU (host);
                                                bit-identical features either way. Default: {self.hashOn}
+  --ingest <object|block>                      Replay ingestion: per-tweet Status objects, or
+                                               columnar blocks via the native C parser (~10x
+                                               ingest throughput; replay source only). Default: {self.ingest}
   --l2Reg <float>                              L2 regularization. Default: {self.l2Reg}
   --convergenceTol <float>                     SGD convergence tolerance. Default: {self.convergenceTol}
   --dtype <float32|bfloat16|float64>           Device dtype. Default: {self.dtype}
@@ -245,6 +253,10 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
         elif flag == "--hashOn":
             self.hashOn = take()
             if self.hashOn not in ("device", "host"):
+                self.printUsage(1)
+        elif flag == "--ingest":
+            self.ingest = take()
+            if self.ingest not in ("object", "block"):
                 self.printUsage(1)
         elif flag == "--l2Reg":
             self.l2Reg = float(take())

@@ -1,0 +1,63 @@
+"""Columnar tweet blocks — the native data-loader's output format.
+
+A ParsedBlock is a filtered batch of tweets in columnar form, straight from
+the C parser (native/tweetjson.cpp): the featurizer-relevant numeric fields,
+plus the original tweets' text as concatenated UTF-16 code units. It skips
+per-tweet Python objects entirely — the ~11 µs/tweet of json.loads +
+Status assembly that caps the object ingest path near 90k tweets/s on one
+core. ``Featurizer.featurize_parsed_block`` turns one (or several merged)
+blocks directly into the UnitBatch wire format.
+
+The Python object path (sources.ReplayFileSource → Status → featurize_*)
+remains the semantic ground truth; differential tests assert the two paths
+produce identical batches.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+# columns of ParsedBlock.numeric (int64), in parser output order
+COL_LABEL = 0  # retweeted status' retweet_count (the label)
+COL_FOLLOWERS = 1
+COL_FAVOURITES = 2
+COL_FRIENDS = 3
+COL_CREATED_MS = 4
+
+
+class ParsedBlock(NamedTuple):
+    """Filtered, columnar tweets. ``numeric`` is int64 [rows, 5] (see COL_*),
+    ``units`` the concatenated UTF-16 code units of the original texts (NOT
+    lowercased), ``offsets`` int64 [rows+1] into units, ``ascii`` uint8
+    [rows] (1 = every unit < 128, so ASCII pad-time folding suffices)."""
+
+    numeric: np.ndarray
+    units: np.ndarray
+    offsets: np.ndarray
+    ascii: np.ndarray
+
+    @property
+    def rows(self) -> int:
+        return int(self.numeric.shape[0])
+
+
+def merge_blocks(blocks: "list[ParsedBlock]") -> ParsedBlock:
+    """Concatenate blocks drained from one micro-batch interval."""
+    if len(blocks) == 1:
+        return blocks[0]
+    numeric = np.concatenate([b.numeric for b in blocks], axis=0)
+    units = np.concatenate([b.units for b in blocks])
+    sizes = [b.offsets[-1] for b in blocks]
+    offsets = [blocks[0].offsets]
+    base = sizes[0]
+    for b, size in zip(blocks[1:], sizes[1:]):
+        offsets.append(b.offsets[1:] + base)
+        base += size
+    return ParsedBlock(
+        numeric,
+        units,
+        np.concatenate(offsets),
+        np.concatenate([b.ascii for b in blocks]),
+    )

@@ -48,6 +48,43 @@ _NUMERIC_COLS = operator.attrgetter(
     "created_at_ms", "retweet_count",
 )
 
+# hand-scaling constants of the reference (MllibHelper.scala:64-67)
+COUNT_SCALE = 1e-12  # followers / favourites / friends
+AGE_SCALE = 1e-14  # tweet age in milliseconds
+
+
+def _pad_ragged_units(
+    units: np.ndarray,
+    offsets: np.ndarray,
+    lengths: np.ndarray,
+    n: int,
+    b: int,
+    lu: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Ragged UTF-16 units → ([b, lu] uint16 buffer, [b] int32 lengths) with
+    ASCII case folded — C row-copy fast path, numpy gather fallback. Shared
+    by both UnitBatch builders (Status lists and columnar blocks)."""
+    from . import native
+
+    padded = (
+        native.pad_units((units, offsets), n, b, lu, ascii_lower=True)
+        if n
+        else None
+    )
+    if padded is not None:
+        return padded
+    buf = np.zeros((b, lu), dtype=np.uint16)
+    length = np.zeros((b,), dtype=np.int32)
+    if n:
+        cols = np.arange(lu, dtype=np.int64)[None, :]
+        valid = cols < lengths[:, None]
+        pos = offsets[:-1, None] + cols
+        buf[:n][valid] = units[pos[valid]]
+        length[:n] = lengths
+        upper = (buf >= 65) & (buf <= 90)
+        buf[upper] += 32
+    return buf, length
+
 
 def _parse_created_at_ms(value: Any) -> int:
     """Twitter timestamps: epoch ms int, ``timestamp_ms`` string, or the
@@ -171,10 +208,10 @@ class Featurizer:
         time_left = now - original.created_at_ms
         return np.array(
             [
-                original.followers_count * 1e-12,
-                original.favourites_count * 1e-12,
-                original.friends_count * 1e-12,
-                time_left * 1e-14,
+                original.followers_count * COUNT_SCALE,
+                original.favourites_count * COUNT_SCALE,
+                original.friends_count * COUNT_SCALE,
+                time_left * AGE_SCALE,
             ],
             dtype=np.float32,
         )
@@ -273,8 +310,8 @@ class Featurizer:
             itertools.chain.from_iterable(map(_NUMERIC_COLS, originals)),
             np.float64, n * 5,
         ).reshape(n, 5)
-        numeric[:n, :3] = cols[:, :3] * 1e-12
-        numeric[:n, 3] = (now - cols[:, 3]) * 1e-14
+        numeric[:n, :3] = cols[:, :3] * COUNT_SCALE
+        numeric[:n, 3] = (now - cols[:, 3]) * AGE_SCALE
         if self.label_fn is None:
             label[:n] = cols[:, 4]
         else:
@@ -328,23 +365,90 @@ class Featurizer:
             if unit_bucket >= max(max_len, 2) and unit_bucket > 0
             else _bucket(max(max_len, 2))
         )
-        padded = (
-            native.pad_units((units, offsets), n, b, lu, ascii_lower=True)
-            if n
-            else None
-        )
-        if padded is not None:
-            buf, length = padded
-        else:
-            buf = np.zeros((b, lu), dtype=np.uint16)
-            length = np.zeros((b,), dtype=np.int32)
-            if n:
-                cols = np.arange(lu, dtype=np.int64)[None, :]
-                valid = cols < lengths[:, None]
-                pos = offsets[:-1, None] + cols
-                buf[:n][valid] = units[pos[valid]]
-                length[:n] = lengths
-                upper = (buf >= 65) & (buf <= 90)
-                buf[upper] += 32
+        buf, length = _pad_ragged_units(units, offsets, lengths, n, b, lu)
         numeric, label, mask = self._numeric_label_mask(keep, originals, b)
+        return UnitBatch(buf, length, numeric, label, mask)
+
+    def featurize_parsed_block(
+        self,
+        block,
+        row_bucket: int = 0,
+        unit_bucket: int = 0,
+        row_multiple: int = 1,
+    ) -> UnitBatch:
+        """Columnar block (features/blocks.py, rows already filtered by the
+        native parser) → UnitBatch, with zero per-tweet Python work in the
+        common case: numeric scaling is vectorized and text goes straight to
+        the C pad (ASCII case folded there). Only rows containing non-ASCII
+        units — or every row under ``normalize_accents`` — pay a Python
+        lower()/normalize round-trip. Custom ``label_fn`` is not supported
+        here (it reads Status objects; use the object ingest path)."""
+        from . import native
+        from .batch import _bucket, pad_row_count
+        from .blocks import (
+            COL_CREATED_MS,
+            COL_FAVOURITES,
+            COL_FOLLOWERS,
+            COL_FRIENDS,
+            COL_LABEL,
+        )
+
+        if self.label_fn is not None:
+            raise ValueError(
+                "featurize_parsed_block does not support label_fn; "
+                "use the object ingest path"
+            )
+        n = block.rows
+        units, offsets = block.units, block.offsets.copy()
+        redo = (
+            np.arange(n)
+            if self.normalize_accents
+            else np.nonzero(block.ascii == 0)[0]
+        )
+        if n and redo.size:
+            # per-row Unicode round-trip for the rows that need it; lengths
+            # may change (e.g. İ → i̇), so reassemble the ragged buffer
+            pieces: list[np.ndarray] = []
+            new_lens = np.diff(block.offsets)
+            redo_set = {}
+            for i in redo:
+                raw = units[block.offsets[i] : block.offsets[i + 1]]
+                text = raw.tobytes().decode("utf-16-le", "surrogatepass").lower()
+                if self.normalize_accents:
+                    text = _strip_accents(text)
+                enc = np.frombuffer(
+                    text.encode("utf-16-le", "surrogatepass"), dtype=np.uint16
+                )
+                redo_set[int(i)] = enc
+                new_lens[i] = enc.size
+            for i in range(n):
+                pieces.append(
+                    redo_set.get(i, units[block.offsets[i] : block.offsets[i + 1]])
+                )
+            units = (
+                np.concatenate(pieces) if pieces else np.zeros(1, np.uint16)
+            )
+            np.cumsum(new_lens, out=offsets[1:])
+        lengths = np.diff(offsets).astype(np.int32)
+        max_len = int(lengths.max()) if n else 0
+        b = pad_row_count(n, row_bucket, row_multiple)
+        lu = (
+            unit_bucket
+            if unit_bucket >= max(max_len, 2) and unit_bucket > 0
+            else _bucket(max(max_len, 2))
+        )
+        buf, length = _pad_ragged_units(units, offsets, lengths, n, b, lu)
+
+        now = self.now_ms if self.now_ms is not None else int(time.time() * 1000)
+        numeric = np.zeros((b, NUM_NUMBER_FEATURES), dtype=np.float32)
+        label = np.zeros((b,), dtype=np.float32)
+        mask = np.zeros((b,), dtype=np.float32)
+        if n:
+            cols64 = block.numeric.astype(np.float64)
+            numeric[:n, 0] = cols64[:, COL_FOLLOWERS] * COUNT_SCALE
+            numeric[:n, 1] = cols64[:, COL_FAVOURITES] * COUNT_SCALE
+            numeric[:n, 2] = cols64[:, COL_FRIENDS] * COUNT_SCALE
+            numeric[:n, 3] = (now - cols64[:, COL_CREATED_MS]) * AGE_SCALE
+            label[:n] = cols64[:, COL_LABEL]
+            mask[:n] = 1.0
         return UnitBatch(buf, length, numeric, label, mask)

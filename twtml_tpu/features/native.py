@@ -21,8 +21,22 @@ from ..utils import get_logger
 log = get_logger("features.native")
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_SRC = os.path.join(_REPO_ROOT, "native", "fasthash.cpp")
+_SRCS = [
+    os.path.join(_REPO_ROOT, "native", "fasthash.cpp"),
+    os.path.join(_REPO_ROOT, "native", "tweetjson.cpp"),
+]
 _LIB = os.path.join(_REPO_ROOT, "native", "libfasthash.so")
+
+
+def _sources_ok() -> bool:
+    return all(os.path.exists(s) for s in _SRCS)
+
+
+def _sources_newer_than_lib() -> bool:
+    lib_mtime = os.path.getmtime(_LIB)
+    return any(
+        os.path.exists(s) and os.path.getmtime(s) > lib_mtime for s in _SRCS
+    )
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -32,7 +46,7 @@ _tried = False
 def _build() -> bool:
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-o", _LIB, _SRC],
+            ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-o", _LIB, *_SRCS],
             check=True, capture_output=True, timeout=120,
         )
         return True
@@ -48,11 +62,8 @@ def get_lib() -> ctypes.CDLL | None:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
-        ):
-            if not os.path.exists(_SRC) or not _build():
+        if not os.path.exists(_LIB) or _sources_newer_than_lib():
+            if not _sources_ok() or not _build():
                 return None
         try:
             lib = _load(_LIB)
@@ -65,7 +76,7 @@ def get_lib() -> ctypes.CDLL | None:
                 os.remove(_LIB)
             except OSError:
                 pass
-            if not os.path.exists(_SRC) or not _build():
+            if not _sources_ok() or not _build():
                 log.warning("native featurizer is stale and could not be "
                             "rebuilt; using python path")
                 return None
@@ -106,6 +117,21 @@ def _load(path: str) -> ctypes.CDLL:
         ctypes.c_int32,  # ascii_lower
         ctypes.POINTER(ctypes.c_uint16),  # out_units
         ctypes.POINTER(ctypes.c_int32),  # out_len
+    ]
+    lib.parse_tweet_block.restype = ctypes.c_int64
+    lib.parse_tweet_block.argtypes = [
+        ctypes.c_char_p,  # buf
+        ctypes.c_int64,  # len
+        ctypes.c_int64,  # begin
+        ctypes.c_int64,  # end
+        ctypes.c_int64,  # cap_rows
+        ctypes.c_int64,  # cap_units
+        ctypes.POINTER(ctypes.c_int64),  # out_numeric [rows,5]
+        ctypes.POINTER(ctypes.c_uint16),  # out_units
+        ctypes.POINTER(ctypes.c_int64),  # out_offsets [rows+1]
+        ctypes.POINTER(ctypes.c_uint8),  # out_ascii [rows]
+        ctypes.POINTER(ctypes.c_int64),  # consumed
+        ctypes.POINTER(ctypes.c_int64),  # bad_lines
     ]
     return lib
 
@@ -216,3 +242,57 @@ def hash_texts(
         # token bucket too small, or a row overflowed the C scratch table
         return None
     return ntok
+
+
+def parse_tweet_block(
+    data: bytes,
+    begin: int,
+    end: int,
+    cap_rows: int = 0,
+) -> tuple | None:
+    """Parse newline-delimited tweet JSON with the C data-loader, applying
+    the isRetweet + [begin, end] retweet-count filter in-line.
+
+    Returns (numeric int64 [rows, 5] = {label, followers, favourites,
+    friends, created_ms}, units uint16 (concatenated), offsets int64
+    [rows+1], ascii uint8 [rows], consumed_bytes, bad_lines) — or None when
+    the C library is unavailable (callers fall back to the Python
+    json.loads + Status path, the semantic ground truth)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(data)
+    if cap_rows <= 0:
+        cap_rows = max(16, data.count(b"\n") + 1)
+    # total text units from n input bytes is < n; the parser additionally
+    # reserves one full row (kMaxTextUnits = 4096) of headroom before each
+    # line, so size past that to never trip the early-stop mid-block
+    cap_units = n + 4096 + 1
+    numeric = np.empty((cap_rows, 5), dtype=np.int64)
+    units = np.empty((cap_units,), dtype=np.uint16)
+    offsets = np.empty((cap_rows + 1,), dtype=np.int64)
+    ascii_flags = np.empty((cap_rows,), dtype=np.uint8)
+    consumed = ctypes.c_int64(0)
+    bad = ctypes.c_int64(0)
+    rows = lib.parse_tweet_block(
+        data,
+        n,
+        begin,
+        end,
+        cap_rows,
+        cap_units,
+        numeric.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        units.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ascii_flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.byref(consumed),
+        ctypes.byref(bad),
+    )
+    return (
+        numeric[:rows],
+        units[: offsets[rows]],
+        offsets[: rows + 1],
+        ascii_flags[:rows],
+        int(consumed.value),
+        int(bad.value),
+    )

@@ -77,6 +77,19 @@ class FeatureStream(RawStream):
     def _process(
         self, statuses: list[Status], batch_time: float
     ) -> "FeatureBatch | UnitBatch":
+        from ..features.blocks import ParsedBlock, merge_blocks
+
+        if statuses and isinstance(statuses[0], ParsedBlock):
+            # native block ingest: items are pre-filtered columnar blocks
+            # (sources.BlockReplayFileSource); featurize without per-tweet
+            # Python objects
+            batch = self.featurizer.featurize_parsed_block(
+                merge_blocks(statuses), row_bucket=self.row_bucket,
+                unit_bucket=self.token_bucket, row_multiple=self.row_multiple,
+            )
+            for fn in self._outputs:
+                fn(batch, batch_time)
+            return batch
         if self.device_hash:
             # ship raw code units; the learner hashes bigrams on device
             # (ops/text_hash.py) — bit-identical features, ~2x host headroom
