@@ -139,9 +139,15 @@ bool scan_string(Cursor& cur, uint16_t* out, int64_t cap, int64_t* n_units) {
 
 // ---- generic value skipping ----------------------------------------------
 
-bool skip_value(Cursor& cur);
+// Depth cap: a well-formed line with ~100k nested brackets would otherwise
+// recurse once per level and smash the C stack; past the cap the line is a
+// counted bad line, like the Python fallback's caught RecursionError.
+constexpr int kMaxSkipDepth = 256;
 
-bool skip_container(Cursor& cur, char open, char close) {
+bool skip_value(Cursor& cur, int depth = 0);
+
+bool skip_container(Cursor& cur, char open, char close, int depth) {
+  if (depth >= kMaxSkipDepth) return false;
   if (!cur.eat(open)) return false;
   cur.skip_ws();
   if (cur.peek() == close) { ++cur.p; return true; }
@@ -150,7 +156,7 @@ bool skip_container(Cursor& cur, char open, char close) {
       if (!scan_string(cur, nullptr, 0, nullptr)) return false;
       if (!cur.eat(':')) return false;
     }
-    if (!skip_value(cur)) return false;
+    if (!skip_value(cur, depth + 1)) return false;
     cur.skip_ws();
     if (cur.peek() == ',') { ++cur.p; cur.skip_ws(); continue; }
     if (cur.peek() == close) { ++cur.p; return true; }
@@ -158,12 +164,12 @@ bool skip_container(Cursor& cur, char open, char close) {
   }
 }
 
-bool skip_value(Cursor& cur) {
+bool skip_value(Cursor& cur, int depth) {
   cur.skip_ws();
   char c = cur.peek();
   if (c == '"') return scan_string(cur, nullptr, 0, nullptr);
-  if (c == '{') return skip_container(cur, '{', '}');
-  if (c == '[') return skip_container(cur, '[', ']');
+  if (c == '{') return skip_container(cur, '{', '}', depth);
+  if (c == '[') return skip_container(cur, '[', ']', depth);
   // number / true / false / null: scan to a structural delimiter
   const char* start = cur.p;
   while (!cur.at_end() && *cur.p != ',' && *cur.p != '}' && *cur.p != ']' &&
@@ -174,23 +180,28 @@ bool skip_value(Cursor& cur) {
 
 // Parse an integer-valued JSON number (or a string wrapping one, Twitter's
 // "timestamp_ms"); fractional digits are truncated. Returns false on
-// non-numeric values (caller leaves the field at its default).
+// non-numeric values with the cursor UNTOUCHED (parsing happens on a probe
+// copy), so the caller's skip_value fallback starts from a clean position —
+// e.g. a non-numeric quoted value is then skipped as a string, matching the
+// Python path's keep-the-row-with-default behavior.
 bool parse_int(Cursor& cur, int64_t* out) {
-  cur.skip_ws();
-  bool quoted = cur.peek() == '"';
-  if (quoted) ++cur.p;
+  Cursor probe = cur;
+  probe.skip_ws();
+  bool quoted = probe.peek() == '"';
+  if (quoted) ++probe.p;
   bool neg = false;
-  if (cur.peek() == '-') { neg = true; ++cur.p; }
-  if (cur.at_end() || *cur.p < '0' || *cur.p > '9') return false;
+  if (probe.peek() == '-') { neg = true; ++probe.p; }
+  if (probe.at_end() || *probe.p < '0' || *probe.p > '9') return false;
   int64_t v = 0;
-  while (!cur.at_end() && *cur.p >= '0' && *cur.p <= '9')
-    v = v * 10 + (*cur.p++ - '0');
-  if (!cur.at_end() && *cur.p == '.') {  // truncate fraction
-    ++cur.p;
-    while (!cur.at_end() && *cur.p >= '0' && *cur.p <= '9') ++cur.p;
+  while (!probe.at_end() && *probe.p >= '0' && *probe.p <= '9')
+    v = v * 10 + (*probe.p++ - '0');
+  if (!probe.at_end() && *probe.p == '.') {  // truncate fraction
+    ++probe.p;
+    while (!probe.at_end() && *probe.p >= '0' && *probe.p <= '9') ++probe.p;
   }
-  if (quoted && !cur.eat('"')) return false;
+  if (quoted && !probe.eat('"')) return false;
   *out = neg ? -v : v;
+  cur = probe;
   return true;
 }
 

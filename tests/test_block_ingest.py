@@ -254,3 +254,35 @@ def test_block_ingest_rejects_host_hashing():
     ])
     with pytest.raises(SystemExit):
         build_source(conf, allow_block=True)
+
+
+def test_non_numeric_timestamp_keeps_row(feat, tmp_path):
+    """A quoted non-numeric timestamp_ms must not desync the parser: the
+    row survives with created_ms falling back (parity with Status's
+    tolerant _parse_created_at_ms)."""
+    path = tmp_path / "badnum.jsonl"
+    obj = {"text": "RT", "retweeted_status": {
+        "text": "odd timestamp", "retweet_count": 500,
+        "user": {"followers_count": 1, "favourites_count": 1,
+                 "friends_count": 1}, "timestamp_ms": "not a number"}}
+    path.write_text(json.dumps(obj) + "\n", encoding="utf-8")
+    o = _object_path_batch(str(path), feat, row_bucket=8)
+    b = _block_path_batch(str(path), feat, row_bucket=8)
+    assert o.num_valid == b.num_valid == 1
+    _assert_batches_equal(o, b)
+
+
+def test_deeply_nested_json_is_a_bad_line_not_a_crash(feat, tmp_path):
+    """~100k nested brackets are well-formed JSON but must not smash the C
+    stack — counted bad, stream continues."""
+    path = tmp_path / "deep.jsonl"
+    good = {"text": "RT", "retweeted_status": {"text": "ok", "retweet_count": 500,
+            "user": {"followers_count": 1, "favourites_count": 1,
+                     "friends_count": 1}, "timestamp_ms": "1785313333333"}}
+    deep = '{"x": ' + "[" * 100000 + "]" * 100000 + "}"
+    path.write_text(
+        json.dumps(good) + "\n" + deep + "\n" + json.dumps(good) + "\n",
+        encoding="utf-8",
+    )
+    blk = _block_path_batch(str(path), feat, row_bucket=8)
+    assert blk.num_valid == 2

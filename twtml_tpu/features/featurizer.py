@@ -406,11 +406,14 @@ class Featurizer:
             else np.nonzero(block.ascii == 0)[0]
         )
         if n and redo.size:
-            # per-row Unicode round-trip for the rows that need it; lengths
-            # may change (e.g. İ → i̇), so reassemble the ragged buffer
-            pieces: list[np.ndarray] = []
+            # per-row Unicode round-trip for the rows that need it. The
+            # common case (lower() preserves length) writes in place —
+            # O(redo rows), not O(all rows); only a length-CHANGING mapping
+            # (e.g. İ → i̇) forces a ragged reassembly, and then only the
+            # changed rows pay Python-level work
+            new_units = units.copy()
             new_lens = np.diff(block.offsets)
-            redo_set = {}
+            resized: dict[int, np.ndarray] = {}
             for i in redo:
                 raw = units[block.offsets[i] : block.offsets[i + 1]]
                 text = raw.tobytes().decode("utf-16-le", "surrogatepass").lower()
@@ -419,16 +422,22 @@ class Featurizer:
                 enc = np.frombuffer(
                     text.encode("utf-16-le", "surrogatepass"), dtype=np.uint16
                 )
-                redo_set[int(i)] = enc
-                new_lens[i] = enc.size
-            for i in range(n):
-                pieces.append(
-                    redo_set.get(i, units[block.offsets[i] : block.offsets[i + 1]])
-                )
-            units = (
-                np.concatenate(pieces) if pieces else np.zeros(1, np.uint16)
-            )
-            np.cumsum(new_lens, out=offsets[1:])
+                if enc.size == raw.size:
+                    new_units[block.offsets[i] : block.offsets[i + 1]] = enc
+                else:
+                    resized[int(i)] = enc
+                    new_lens[i] = enc.size
+            if resized:
+                pieces = [
+                    resized.get(
+                        i, new_units[block.offsets[i] : block.offsets[i + 1]]
+                    )
+                    for i in range(n)
+                ]
+                units = np.concatenate(pieces) if pieces else np.zeros(1, np.uint16)
+                np.cumsum(new_lens, out=offsets[1:])
+            else:
+                units = new_units
         lengths = np.diff(offsets).astype(np.int32)
         max_len = int(lengths.max()) if n else 0
         b = pad_row_count(n, row_bucket, row_multiple)

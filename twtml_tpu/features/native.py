@@ -288,11 +288,14 @@ def parse_tweet_block(
         ctypes.byref(consumed),
         ctypes.byref(bad),
     )
+    # copies, not views: the backing buffers are sized for the worst case
+    # (~3 bytes per input byte) and callers accumulate blocks — returning
+    # views would pin that capacity for the life of every block
     return (
-        numeric[:rows],
-        units[: offsets[rows]],
-        offsets[: rows + 1],
-        ascii_flags[:rows],
+        numeric[:rows].copy(),
+        units[: offsets[rows]].copy(),
+        offsets[: rows + 1].copy(),
+        ascii_flags[:rows].copy(),
         int(consumed.value),
         int(bad.value),
     )
