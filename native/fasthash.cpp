@@ -219,4 +219,86 @@ int32_t pad_units_batch(const uint16_t* units, const int64_t* offsets,
   return max_len;
 }
 
+// Lexicon sentiment scorer over raw UTF-16 units (features/sentiment.py's
+// C hot path). Tokenization matches the Python `[a-z']+` regex over
+// lowercased text for ASCII rows: A-Z fold inline, every other unit is a
+// separator. Rows containing units >= 128 are flagged not-ok (out_ok = 0)
+// and the caller scores them in Python — Unicode lowercasing can change
+// token boundaries, so exact parity demands the Python path there.
+// Lexicon words arrive as concatenated units + offsets with precomputed
+// Java-hashCode values; a hash hit verifies the actual units, so a
+// colliding non-lexicon token can never flip a label vs the Python set.
+namespace {
+int32_t lexicon_find(const uint16_t* tok, int32_t tok_len, int32_t tok_hash,
+                     const uint16_t* words, const int64_t* word_off,
+                     const int32_t* word_hash, int32_t n_words) {
+  for (int32_t w = 0; w < n_words; ++w) {
+    if (word_hash[w] != tok_hash) continue;
+    const int64_t len = word_off[w + 1] - word_off[w];
+    if (len != tok_len) continue;
+    if (std::memcmp(words + word_off[w], tok,
+                    tok_len * sizeof(uint16_t)) == 0)
+      return w;
+  }
+  return -1;
+}
+}  // namespace
+
+void lexicon_score_batch(const uint16_t* units, const int64_t* offsets,
+                         int32_t batch,
+                         const uint16_t* pos_words, const int64_t* pos_off,
+                         const int32_t* pos_hash, int32_t n_pos,
+                         const uint16_t* neg_words, const int64_t* neg_off,
+                         const int32_t* neg_hash, int32_t n_neg,
+                         int32_t* out_score, uint8_t* out_ok) {
+  for (int32_t b = 0; b < batch; ++b) {
+    const int64_t start = offsets[b];
+    const int64_t end = offsets[b + 1];
+    bool ascii = true;
+    for (int64_t i = start; i < end; ++i)
+      if (units[i] >= 128) { ascii = false; break; }
+    if (!ascii) {
+      out_ok[b] = 0;
+      out_score[b] = 0;
+      continue;
+    }
+    int32_t score = 0;
+    uint16_t tok[64];
+    int32_t tok_len = 0;
+    int32_t tok_hash = 0;
+    bool overflow = false;
+    auto flush = [&]() {
+      if (tok_len > 0 && !overflow) {
+        if (lexicon_find(tok, tok_len, tok_hash, pos_words, pos_off,
+                         pos_hash, n_pos) >= 0)
+          ++score;
+        else if (lexicon_find(tok, tok_len, tok_hash, neg_words, neg_off,
+                              neg_hash, n_neg) >= 0)
+          --score;
+      }
+      tok_len = 0;
+      tok_hash = 0;
+      overflow = false;
+    };
+    for (int64_t i = start; i < end; ++i) {
+      uint16_t u = units[i];
+      if (u >= 'A' && u <= 'Z') u += 32;
+      if ((u >= 'a' && u <= 'z') || u == '\'') {
+        if (tok_len < 64) {
+          tok[tok_len++] = u;
+          tok_hash = static_cast<int32_t>(31u * static_cast<uint32_t>(tok_hash) +
+                                          static_cast<uint32_t>(u));
+        } else {
+          overflow = true;  // longer than any lexicon word: never matches
+        }
+      } else {
+        flush();
+      }
+    }
+    flush();
+    out_score[b] = score;
+    out_ok[b] = 1;
+  }
+}
+
 }  // extern "C"

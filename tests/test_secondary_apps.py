@@ -70,3 +70,67 @@ def test_logistic_app_on_replay(capsys):
     assert totals["count"] == 6
     out = capsys.readouterr().out
     assert "errRate:" in out
+
+
+class TestBatchSentiment:
+    """The C lexicon scorer (native/fasthash.cpp lexicon_score_batch) must
+    label exactly like the per-status Python ground truth."""
+
+    CASES = [
+        "good vibes only",
+        "this is BAD, really TERRIBLE stuff",
+        "GREAT!!! but the problem... isn't awful?",
+        "don't hate, it's the best",  # apostrophes inside tokens
+        "goodness gracious",  # 'goodness' must NOT match 'good'
+        "café terrible",  # non-ASCII row -> python fallback path
+        "ΣΙΓΜΑ bad",  # non-ASCII uppercase
+        "",  # empty text
+        "x" * 500,  # token longer than any lexicon word
+        "win-win fail/fail",  # punctuation separators
+    ]
+
+    def _statuses(self):
+        from twtml_tpu.features.featurizer import Status
+
+        return [
+            Status(text="RT", retweeted_status=Status(text=t, retweet_count=200))
+            for t in self.CASES
+        ]
+
+    def test_matches_per_status_labeler(self):
+        import numpy as np
+
+        from twtml_tpu.features.sentiment import sentiment_label, sentiment_labels
+
+        statuses = self._statuses()
+        got = sentiment_labels(statuses)
+        want = np.array([sentiment_label(s) for s in statuses], np.float32)
+        np.testing.assert_array_equal(got, want)
+
+    def test_matches_without_native_library(self, monkeypatch):
+        import numpy as np
+
+        from twtml_tpu.features import native
+        from twtml_tpu.features.sentiment import sentiment_label, sentiment_labels
+
+        monkeypatch.setattr(native, "lexicon_scores", lambda *a, **k: None)
+        statuses = self._statuses()
+        got = sentiment_labels(statuses)
+        want = np.array([sentiment_label(s) for s in statuses], np.float32)
+        np.testing.assert_array_equal(got, want)
+
+    def test_featurizer_batch_label_fn_parity(self):
+        import numpy as np
+
+        from twtml_tpu.features.featurizer import Featurizer
+        from twtml_tpu.features.sentiment import sentiment_label, sentiment_labels
+
+        slow = Featurizer(now_ms=0, label_fn=sentiment_label)
+        fast = Featurizer(
+            now_ms=0, label_fn=sentiment_label, batch_label_fn=sentiment_labels
+        )
+        statuses = self._statuses()
+        a = slow.featurize_batch_units(statuses, pre_filtered=True)
+        b = fast.featurize_batch_units(statuses, pre_filtered=True)
+        np.testing.assert_array_equal(a.label, b.label)
+        np.testing.assert_array_equal(a.units, b.units)

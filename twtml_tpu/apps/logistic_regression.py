@@ -19,7 +19,7 @@ import numpy as np
 
 from ..config import ConfArguments
 from ..features.featurizer import Featurizer
-from ..features.sentiment import sentiment_label
+from ..features.sentiment import sentiment_label, sentiment_labels
 from ..models.logistic import StreamingLogisticRegressionWithSGD
 from ..streaming.context import StreamingContext
 from ..telemetry.session_stats import SessionStats
@@ -34,6 +34,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     select_backend(conf)
     featurizer = Featurizer.from_conf(conf)
     featurizer.label_fn = sentiment_label
+    featurizer.batch_label_fn = sentiment_labels  # C hot path, same labels
     model = StreamingLogisticRegressionWithSGD.from_conf(conf)
 
     ssc = StreamingContext(batch_interval=conf.seconds)

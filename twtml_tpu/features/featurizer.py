@@ -22,6 +22,8 @@ here because they are plain bugs there:
 
 from __future__ import annotations
 
+import functools
+import inspect
 import itertools
 import operator
 import time
@@ -51,6 +53,16 @@ _NUMERIC_COLS = operator.attrgetter(
 # hand-scaling constants of the reference (MllibHelper.scala:64-67)
 COUNT_SCALE = 1e-12  # followers / favourites / friends
 AGE_SCALE = 1e-14  # tweet age in milliseconds
+
+
+@functools.lru_cache(maxsize=32)
+def _accepts_encoded(fn) -> bool:
+    """Whether a batched labeler declares an ``encoded=`` keyword (the
+    opt-in contract for reusing the featurizer's UTF-16 encode pass)."""
+    try:
+        return "encoded" in inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
 
 
 def _pad_ragged_units(
@@ -171,6 +183,9 @@ class Featurizer:
     normalize_accents: bool = False  # reference computes-and-drops, §2.5
     now_ms: int | None = None  # fixed clock for deterministic replay; None=wall
     label_fn: "Callable[[Status], float] | None" = None  # default: retweetCount
+    # optional batched form of label_fn (same semantics, one call per batch)
+    # for hot paths — e.g. features/sentiment.py sentiment_labels
+    batch_label_fn: "Callable[[list[Status]], np.ndarray] | None" = None
     num_number_features: int = field(default=NUM_NUMBER_FEATURES, init=False)
 
     @classmethod
@@ -245,7 +260,23 @@ class Featurizer:
         fast = self._featurize_batch_native(keep, row_bucket, token_bucket, row_multiple)
         if fast is not None:
             return fast
-        rows = [self.featurize(s) for s in keep]
+        if self.batch_label_fn is not None:
+            # featurize() consults label_fn only; the batched labeler must
+            # apply on this fallback path too (else labels silently revert).
+            # Features first with whatever label featurize produces cheaply,
+            # then one batched labeling pass (never both per-status AND
+            # batched — that would double the labeling cost here)
+            rows = [
+                (self.featurize_text(s), self.featurize_numbers(s), 0.0)
+                for s in keep
+            ]
+            labels = self.batch_label_fn(keep)
+            rows = [
+                (text, nums, float(lab))
+                for (text, nums, _), lab in zip(rows, labels)
+            ]
+        else:
+            rows = [self.featurize(s) for s in keep]
         # token_val here is always hashing_tf_counts output — counts by
         # construction (label_fn customizes labels, never token values)
         return pad_feature_batch(
@@ -290,15 +321,20 @@ class Featurizer:
         if ntok is None:
             return None
 
-        numeric, label, mask = self._numeric_label_mask(keep, originals, b)
+        numeric, label, mask = self._numeric_label_mask(
+            keep, originals, b, encoded=encoded
+        )
         token_idx, token_val = compact_tokens(
             token_idx, token_val, self.num_text_features, counts=True,
             validate=False,  # C hasher output is in-range by construction
         )
         return FeatureBatch(token_idx, token_val, numeric, label, mask)
 
-    def _numeric_label_mask(self, keep, originals, b: int):
-        """Padded numeric/label/mask columns, one attrgetter pass."""
+    def _numeric_label_mask(self, keep, originals, b: int, encoded=None):
+        """Padded numeric/label/mask columns, one attrgetter pass.
+        ``encoded``: the batch's already-computed (units, offsets) of the
+        originals' (lowercased) texts, offered to a batched labeler that
+        accepts it — avoids a second encode pass on the hot path."""
         n = len(keep)
         numeric = np.zeros((b, NUM_NUMBER_FEATURES), dtype=np.float32)
         label = np.zeros((b,), dtype=np.float32)
@@ -312,11 +348,14 @@ class Featurizer:
         ).reshape(n, 5)
         numeric[:n, :3] = cols[:, :3] * COUNT_SCALE
         numeric[:n, 3] = (now - cols[:, 3]) * AGE_SCALE
-        if self.label_fn is None:
+        if self.batch_label_fn is not None:
+            if encoded is not None and _accepts_encoded(self.batch_label_fn):
+                label[:n] = self.batch_label_fn(keep, encoded=encoded)
+            else:
+                label[:n] = self.batch_label_fn(keep)
+        elif self.label_fn is None:
             label[:n] = cols[:, 4]
         else:
-            # custom labels (e.g. lexicon sentiment) are host-side
-            # per-status Python either way; the hashing still runs vectorized
             label[:n] = [self.label_fn(s) for s in keep]
         mask[:n] = 1.0
         return numeric, label, mask
@@ -366,7 +405,12 @@ class Featurizer:
             else _bucket(max(max_len, 2))
         )
         buf, length = _pad_ragged_units(units, offsets, lengths, n, b, lu)
-        numeric, label, mask = self._numeric_label_mask(keep, originals, b)
+        # the encode is reusable by a batched labeler only when it reflects
+        # the plain lowercased text (accent stripping changes the tokens)
+        enc = (units, offsets) if not self.normalize_accents else None
+        numeric, label, mask = self._numeric_label_mask(
+            keep, originals, b, encoded=enc
+        )
         return UnitBatch(buf, length, numeric, label, mask)
 
     def featurize_parsed_block(
@@ -393,9 +437,9 @@ class Featurizer:
             COL_LABEL,
         )
 
-        if self.label_fn is not None:
+        if self.label_fn is not None or self.batch_label_fn is not None:
             raise ValueError(
-                "featurize_parsed_block does not support label_fn; "
+                "featurize_parsed_block does not support custom labels; "
                 "use the object ingest path"
             )
         n = block.rows

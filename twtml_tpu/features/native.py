@@ -118,6 +118,22 @@ def _load(path: str) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_uint16),  # out_units
         ctypes.POINTER(ctypes.c_int32),  # out_len
     ]
+    lib.lexicon_score_batch.restype = None
+    lib.lexicon_score_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_uint16),  # units
+        ctypes.POINTER(ctypes.c_int64),  # offsets
+        ctypes.c_int32,  # batch
+        ctypes.POINTER(ctypes.c_uint16),  # pos_words
+        ctypes.POINTER(ctypes.c_int64),  # pos_off
+        ctypes.POINTER(ctypes.c_int32),  # pos_hash
+        ctypes.c_int32,  # n_pos
+        ctypes.POINTER(ctypes.c_uint16),  # neg_words
+        ctypes.POINTER(ctypes.c_int64),  # neg_off
+        ctypes.POINTER(ctypes.c_int32),  # neg_hash
+        ctypes.c_int32,  # n_neg
+        ctypes.POINTER(ctypes.c_int32),  # out_score
+        ctypes.POINTER(ctypes.c_uint8),  # out_ok
+    ]
     lib.parse_tweet_block.restype = ctypes.c_int64
     lib.parse_tweet_block.argtypes = [
         ctypes.c_char_p,  # buf
@@ -299,3 +315,43 @@ def parse_tweet_block(
         int(consumed.value),
         int(bad.value),
     )
+
+
+def lexicon_scores(
+    encoded: tuple[np.ndarray, np.ndarray],
+    n: int,
+    pos_lex: tuple[np.ndarray, np.ndarray, np.ndarray],
+    neg_lex: tuple[np.ndarray, np.ndarray, np.ndarray],
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """Batch lexicon sentiment scores over ragged UTF-16 units.
+
+    ``pos_lex``/``neg_lex`` are (words_units, word_offsets, word_hashes)
+    from features/sentiment.py's packed lexicons. Returns (scores int32 [n],
+    ok uint8 [n]) — ok=0 rows contain non-ASCII units and must be scored in
+    Python for exact tokenization parity. None when the C library is
+    unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    units, offsets = encoded
+    assert offsets.size == n + 1, "encoded does not match the batch"
+    score = np.empty((n,), dtype=np.int32)
+    ok = np.empty((n,), dtype=np.uint8)
+    pw, po, ph = pos_lex
+    nw, no, nh = neg_lex
+    lib.lexicon_score_batch(
+        units.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        pw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        po.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ph.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(ph),
+        nw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        no.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        nh.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(nh),
+        score.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return score, ok

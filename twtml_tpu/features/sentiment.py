@@ -40,3 +40,54 @@ def sentiment_label(status: Status) -> float:
     """Binary label from the ORIGINAL tweet's text (featurization also reads
     the original, MllibHelper.scala:42-44)."""
     return 1.0 if sentiment_score(status.retweeted_status.text) >= 0 else 0.0
+
+
+def _pack_lexicon(words: frozenset) -> tuple:
+    """Lexicon as (concatenated UTF-16 units, offsets, Java hashCodes) for
+    the C scorer (native/fasthash.cpp lexicon_score_batch)."""
+    import numpy as np
+
+    from .hashing import java_string_hashcode
+
+    ws = sorted(words)
+    units = np.concatenate([
+        np.frombuffer(w.encode("utf-16-le"), np.uint16) for w in ws
+    ])
+    off = np.zeros(len(ws) + 1, np.int64)
+    np.cumsum([len(w) for w in ws], out=off[1:])
+    hashes = np.array([java_string_hashcode(w) for w in ws], np.int32)
+    return units, off, hashes
+
+
+_POS_PACKED = _pack_lexicon(POSITIVE)
+_NEG_PACKED = _pack_lexicon(NEGATIVE)
+
+
+def sentiment_labels(statuses: list, encoded=None) -> "np.ndarray":
+    """Batched ``sentiment_label`` over the ORIGINAL texts — C hot path
+    (one scan over UTF-16 units), exact per-row Python fallback for
+    non-ASCII texts and when the library is unavailable.
+
+    ``encoded``: optionally the featurizer's already-computed
+    (units, offsets) of the originals' (lowercased) texts — skips a second
+    encode pass; the C scorer's ASCII fold is idempotent on pre-lowered
+    rows, and Python-scored fallback rows lowercase idempotently too."""
+    import numpy as np
+
+    from . import native
+
+    n = len(statuses)
+    out = None
+    if n and native.available():
+        if encoded is None:
+            encoded = native.encode_texts(
+                [s.retweeted_status.text for s in statuses]
+            )
+        out = native.lexicon_scores(encoded, n, _POS_PACKED, _NEG_PACKED)
+    if out is None:
+        return np.array([sentiment_label(s) for s in statuses], np.float32)
+    score, ok = out
+    labels = (score >= 0).astype(np.float32)
+    for i in np.nonzero(ok == 0)[0]:
+        labels[i] = sentiment_label(statuses[i])
+    return labels
