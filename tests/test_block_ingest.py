@@ -286,3 +286,117 @@ def test_deeply_nested_json_is_a_bad_line_not_a_crash(feat, tmp_path):
     )
     blk = _block_path_batch(str(path), feat, row_bucket=8)
     assert blk.num_valid == 2
+
+
+@pytest.mark.parametrize("ensure_ascii", [True, False])
+def test_fuzzed_unicode_parity(feat, tmp_path, ensure_ascii):
+    """Seeded fuzz: random unicode texts (BMP, astral, quotes, escapes,
+    controls) serialized with and without \\uXXXX escaping must parse
+    identically to the Python path."""
+    import random
+
+    rng = random.Random(20260730 + int(ensure_ascii))
+    alphabet = (
+        [chr(c) for c in range(0x20, 0x7F)]  # printable ASCII incl. " and \\
+        + ["\n", "\t", "\r", "\b", "\f"]
+        + [chr(rng.randrange(0xA0, 0x2FFF)) for _ in range(40)]  # BMP
+        + ["é", "你", "İ", "ẞ"]  # é, 你, İ, ẞ
+        + [chr(rng.randrange(0x10000, 0x10400)) for _ in range(10)]  # astral
+        + ["\U0001f600", "\U0001f525"]
+    )
+    objs = []
+    for i in range(200):
+        text = "".join(rng.choice(alphabet) for _ in range(rng.randrange(0, 60)))
+        objs.append({
+            "text": "RT wrap",
+            "junk": {"nested": [i, None, True, {"deep": [text]}]},
+            "retweeted_status": {
+                "text": text,
+                "retweet_count": rng.randrange(0, 2000),
+                "user": {
+                    "followers_count": rng.randrange(0, 10**9),
+                    "favourites_count": rng.randrange(0, 10**6),
+                    "friends_count": rng.randrange(0, 10**5),
+                },
+                "timestamp_ms": str(rng.randrange(10**12, 2 * 10**12)),
+            },
+        })
+    path = tmp_path / f"fuzz_{ensure_ascii}.jsonl"
+    path.write_text(
+        "\n".join(json.dumps(o, ensure_ascii=ensure_ascii) for o in objs) + "\n",
+        encoding="utf-8",
+    )
+    obj_b = _object_path_batch(str(path), feat, row_bucket=256, unit_bucket=128)
+    blk_b = _block_path_batch(str(path), feat, row_bucket=256, unit_bucket=128)
+    assert obj_b.num_valid > 20  # the filter keeps a healthy sample
+    _assert_batches_equal(obj_b, blk_b)
+
+
+def test_logistic_app_block_ingest_matches_object(capsys):
+    """The logistic app's block path (unit_label_fn sentiment) must produce
+    the same per-batch stats as its object path."""
+    from twtml_tpu.apps import logistic_regression as app
+    from twtml_tpu.config import ConfArguments
+
+    outputs = {}
+    for ingest in ("object", "block"):
+        conf = ConfArguments().parse([
+            "--source", "replay", "--replayFile", DATA, "--ingest", ingest,
+            "--lightning", "http://127.0.0.1:9", "--twtweb", "http://127.0.0.1:9",
+            "--backend", "cpu",
+        ])
+        app.run(conf, max_batches=1)
+        outputs[ingest] = [
+            l for l in capsys.readouterr().out.splitlines()
+            if l.startswith("count:")
+        ]
+    assert outputs["block"] == outputs["object"]
+    assert outputs["block"], "no stats lines captured"
+
+
+def test_unit_label_fn_parity_on_blocks(feat):
+    """sentiment_labels_from_units over a parsed block == per-status
+    sentiment labels over the same tweets."""
+    import numpy as np
+
+    from twtml_tpu.features.sentiment import (
+        sentiment_label,
+        sentiment_labels_from_units,
+    )
+
+    src = BlockReplayFileSource(DATA)
+    block = merge_blocks(list(src.produce()))
+    with open(DATA, encoding="utf-8") as fh:
+        statuses = [Status.from_json(json.loads(l)) for l in fh if l.strip()]
+    kept = [s for s in statuses if feat.filtrate(s)]
+    want = np.array([sentiment_label(s) for s in kept], np.float32)
+    got = sentiment_labels_from_units(block.units, block.offsets)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_unit_labels_use_original_units_under_accent_normalization(tmp_path):
+    """normalize_accents must never leak into labels: stripping 'bàd'→'bad'
+    would change a lexicon hit. Labels come from the ORIGINAL units."""
+    import numpy as np
+
+    from twtml_tpu.features.sentiment import (
+        sentiment_label,
+        sentiment_labels_from_units,
+    )
+
+    path = tmp_path / "accented.jsonl"
+    obj = {"text": "RT", "retweeted_status": {
+        "text": "this is bàd news", "retweet_count": 500,
+        "user": {"followers_count": 1, "favourites_count": 1,
+                 "friends_count": 1}, "timestamp_ms": "1785313333333"}}
+    path.write_text(json.dumps(obj) + "\n", encoding="utf-8")
+    feat = Featurizer(
+        now_ms=1785320000000,
+        normalize_accents=True,
+        unit_label_fn=sentiment_labels_from_units,
+    )
+    src = BlockReplayFileSource(str(path))
+    batch = feat.featurize_parsed_block(merge_blocks(list(src.produce())))
+    with open(path, encoding="utf-8") as fh:
+        status = Status.from_json(json.loads(fh.readline()))
+    assert batch.label[0] == sentiment_label(status) == 1.0  # 'bàd' ≠ 'bad'

@@ -60,9 +60,12 @@ def select_backend(conf) -> None:
 
 def build_source(conf, allow_block: bool = False) -> Source:
     if conf.ingest == "block" and not allow_block:
-        # only the linear app's pipeline consumes ParsedBlocks (k-means
-        # featurizes Status pairs; logistic needs label_fn over Status)
-        raise SystemExit("--ingest block is only supported by the linear app")
+        # ParsedBlock pipelines: linear (default labels) and logistic
+        # (unit_label_fn); k-means featurizes Status pairs and opts out
+        raise SystemExit(
+            "--ingest block is not supported by this app; "
+            "use the linear or logistic entry points"
+        )
     if conf.ingest == "block" and conf.source != "replay":
         raise SystemExit("--ingest block requires --source replay")
     if conf.source == "replay":

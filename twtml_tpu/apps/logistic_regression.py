@@ -19,7 +19,11 @@ import numpy as np
 
 from ..config import ConfArguments
 from ..features.featurizer import Featurizer
-from ..features.sentiment import sentiment_label, sentiment_labels
+from ..features.sentiment import (
+    sentiment_label,
+    sentiment_labels,
+    sentiment_labels_from_units,
+)
 from ..models.logistic import StreamingLogisticRegressionWithSGD
 from ..streaming.context import StreamingContext
 from ..telemetry.session_stats import SessionStats
@@ -35,11 +39,13 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     featurizer = Featurizer.from_conf(conf)
     featurizer.label_fn = sentiment_label
     featurizer.batch_label_fn = sentiment_labels  # C hot path, same labels
+    featurizer.unit_label_fn = sentiment_labels_from_units  # block ingest
     model = StreamingLogisticRegressionWithSGD.from_conf(conf)
 
     ssc = StreamingContext(batch_interval=conf.seconds)
     stream = ssc.source_stream(
-        build_source(conf), featurizer, row_bucket=conf.batchBucket,
+        build_source(conf, allow_block=True), featurizer,
+        row_bucket=conf.batchBucket,
         device_hash=conf.hashOn == "device",
     )
     totals = {"count": 0, "batches": 0}

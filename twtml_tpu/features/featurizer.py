@@ -186,6 +186,9 @@ class Featurizer:
     # optional batched form of label_fn (same semantics, one call per batch)
     # for hot paths — e.g. features/sentiment.py sentiment_labels
     batch_label_fn: "Callable[[list[Status]], np.ndarray] | None" = None
+    # optional labeler over ragged UTF-16 units for the block-ingest path,
+    # where no Status objects exist — e.g. sentiment_labels_from_units
+    unit_label_fn: "Callable[[np.ndarray, np.ndarray], np.ndarray] | None" = None
     num_number_features: int = field(default=NUM_NUMBER_FEATURES, init=False)
 
     @classmethod
@@ -425,8 +428,10 @@ class Featurizer:
         common case: numeric scaling is vectorized and text goes straight to
         the C pad (ASCII case folded there). Only rows containing non-ASCII
         units — or every row under ``normalize_accents`` — pay a Python
-        lower()/normalize round-trip. Custom ``label_fn`` is not supported
-        here (it reads Status objects; use the object ingest path)."""
+        lower()/normalize round-trip. Custom labels: set ``unit_label_fn``
+        (labels from the ORIGINAL raw units, e.g. the lexicon sentiment
+        scorer); the Status-based ``label_fn``/``batch_label_fn`` need the
+        object ingest path and are rejected here."""
         from . import native
         from .batch import _bucket, pad_row_count
         from .blocks import (
@@ -437,10 +442,13 @@ class Featurizer:
             COL_LABEL,
         )
 
-        if self.label_fn is not None or self.batch_label_fn is not None:
+        if self.unit_label_fn is None and (
+            self.label_fn is not None or self.batch_label_fn is not None
+        ):
             raise ValueError(
-                "featurize_parsed_block does not support custom labels; "
-                "use the object ingest path"
+                "featurize_parsed_block labels come from unit_label_fn "
+                "(Status-based label_fn/batch_label_fn need the object "
+                "ingest path)"
             )
         n = block.rows
         units, offsets = block.units, block.offsets.copy()
@@ -502,6 +510,13 @@ class Featurizer:
             numeric[:n, 1] = cols64[:, COL_FAVOURITES] * COUNT_SCALE
             numeric[:n, 2] = cols64[:, COL_FRIENDS] * COUNT_SCALE
             numeric[:n, 3] = (now - cols64[:, COL_CREATED_MS]) * AGE_SCALE
-            label[:n] = cols64[:, COL_LABEL]
+            if self.unit_label_fn is not None:
+                # labels from the ORIGINAL raw units (pre-lower/normalize:
+                # the object path labels over the original text too, and
+                # normalize_accents must never leak into labels — stripping
+                # 'bàd'→'bad' would change a lexicon hit)
+                label[:n] = self.unit_label_fn(block.units, block.offsets)
+            else:
+                label[:n] = cols64[:, COL_LABEL]
             mask[:n] = 1.0
         return UnitBatch(buf, length, numeric, label, mask)

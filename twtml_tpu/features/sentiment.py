@@ -91,3 +91,32 @@ def sentiment_labels(statuses: list, encoded=None) -> "np.ndarray":
     for i in np.nonzero(ok == 0)[0]:
         labels[i] = sentiment_label(statuses[i])
     return labels
+
+
+def sentiment_labels_from_units(units, offsets) -> "np.ndarray":
+    """Batched labels straight from ragged UTF-16 units — the block-ingest
+    path's labeler (no Status objects exist there). C scan for ASCII rows;
+    non-ASCII rows decode and score in Python (pre-lowered units score
+    identically: sentiment_score lowercases idempotently)."""
+    import numpy as np
+
+    from . import native
+
+    n = offsets.size - 1
+    if n <= 0:
+        return np.zeros((0,), np.float32)
+    out = native.lexicon_scores((units, offsets), n, _POS_PACKED, _NEG_PACKED)
+    if out is None:  # no C library: every row takes the Python loop below
+        score = np.zeros((n,), np.int32)
+        ok = np.zeros((n,), np.uint8)
+    else:
+        score, ok = out
+    labels = (score >= 0).astype(np.float32)
+    for i in np.nonzero(ok == 0)[0]:
+        text = (
+            units[offsets[i] : offsets[i + 1]]
+            .tobytes()
+            .decode("utf-16-le", "surrogatepass")
+        )
+        labels[i] = 1.0 if sentiment_score(text) >= 0 else 0.0
+    return labels
