@@ -400,3 +400,25 @@ def test_unit_labels_use_original_units_under_accent_normalization(tmp_path):
     with open(path, encoding="utf-8") as fh:
         status = Status.from_json(json.loads(fh.readline()))
     assert batch.label[0] == sentiment_label(status) == 1.0  # 'bàd' ≠ 'bad'
+
+
+def test_kmeans_app_block_ingest_matches_object(capsys):
+    """k-means block path (numeric-column featurization, NO interval
+    filter) must print the same per-batch centers as the object path."""
+    from twtml_tpu.apps import kmeans as app
+    from twtml_tpu.config import ConfArguments
+
+    outputs = {}
+    for ingest in ("object", "block"):
+        conf = ConfArguments().parse([
+            "--source", "replay", "--replayFile", DATA, "--ingest", ingest,
+            "--lightning", "http://127.0.0.1:9", "--twtweb", "http://127.0.0.1:9",
+            "--backend", "cpu",
+        ])
+        app.run(conf, max_batches=1, wall_clock=False)
+        outputs[ingest] = [
+            l for l in capsys.readouterr().out.splitlines()
+            if l.startswith("count:")
+        ]
+    assert outputs["block"] == outputs["object"]
+    assert outputs["block"], "no stats lines captured"

@@ -88,7 +88,13 @@ def featurize(status: Status) -> np.ndarray:
 
 def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> dict:
     select_backend(conf)
-    source: Source = build_source(conf)
+    # k-means keeps ALL retweets (isRetweet only, NO retweet-count interval —
+    # KMeans.scala:77-80): block ingest overrides the parser's interval
+    # filter; isRetweet filtering is inherent (rows without a
+    # retweeted_status never emit)
+    source: Source = build_source(
+        conf, allow_block=True, block_interval=(0, 2**62)
+    )
 
     # the scatter chart KMeans.scala:86-96 sets up (and :129-132 appends to,
     # commented out there) — best-effort, training survives telemetry
@@ -109,16 +115,31 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
     totals = {"count": 0, "batches": 0}
 
     def on_batch(statuses: list[Status], _batch_time) -> None:
-        retweets = [s for s in statuses if s.is_retweet]  # KMeans.scala:77-80
-        if not retweets:
-            log.debug("batch: 0")
-            return
-        n = len(retweets)
-        # pad rows to a power-of-two bucket so XLA compiles a handful of
-        # shapes, not one per batch size (same policy as features/batch.py)
-        rows = _bucket(n)
-        pts = np.zeros((rows, NUM_DIMENSIONS), np.float32)
-        pts[:n] = np.stack([featurize(s) for s in retweets])
+        from ..features.blocks import COL_FOLLOWERS, COL_LABEL, ParsedBlock, merge_blocks
+
+        if statuses and isinstance(statuses[0], ParsedBlock):
+            # block ingest: both k-means dimensions are numeric columns —
+            # the whole featurization is one vectorized slice
+            block = merge_blocks(statuses)
+            n = block.rows
+            if n == 0:
+                log.debug("batch: 0")
+                return
+            rows = _bucket(n)
+            pts = np.zeros((rows, NUM_DIMENSIONS), np.float32)
+            pts[:n, 0] = block.numeric[:, COL_LABEL]
+            pts[:n, 1] = block.numeric[:, COL_FOLLOWERS]
+        else:
+            retweets = [s for s in statuses if s.is_retweet]  # KMeans.scala:77-80
+            if not retweets:
+                log.debug("batch: 0")
+                return
+            n = len(retweets)
+            # pad rows to a power-of-two bucket so XLA compiles a handful of
+            # shapes, not one per batch size (same policy as features/batch.py)
+            rows = _bucket(n)
+            pts = np.zeros((rows, NUM_DIMENSIONS), np.float32)
+            pts[:n] = np.stack([featurize(s) for s in retweets])
         mask = np.zeros((rows,), np.float32)
         mask[:n] = 1.0
         scaled = np.asarray(scale(pts, mask))

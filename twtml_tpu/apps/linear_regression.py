@@ -58,13 +58,19 @@ def select_backend(conf) -> None:
             raise RuntimeError("--backend tpu requested but only CPU devices present")
 
 
-def build_source(conf, allow_block: bool = False) -> Source:
+def build_source(
+    conf,
+    allow_block: bool = False,
+    block_interval: "tuple[int, int] | None" = None,
+) -> Source:
+    """``allow_block``: set by entry points whose pipelines consume
+    ParsedBlocks (linear: default labels; logistic: unit_label_fn; k-means:
+    numeric columns, which passes ``block_interval`` to override the
+    parser's retweet-count filter — it keeps ALL retweets)."""
     if conf.ingest == "block" and not allow_block:
-        # ParsedBlock pipelines: linear (default labels) and logistic
-        # (unit_label_fn); k-means featurizes Status pairs and opts out
         raise SystemExit(
-            "--ingest block is not supported by this app; "
-            "use the linear or logistic entry points"
+            "--ingest block is not wired for this entry point; "
+            "use --ingest object"
         )
     if conf.ingest == "block" and conf.source != "replay":
         raise SystemExit("--ingest block requires --source replay")
@@ -84,10 +90,13 @@ def build_source(conf, allow_block: bool = False) -> Source:
                     "--ingest block ships raw code units (device hashing); "
                     "--hashOn host requires --ingest object"
                 )
+            begin, end = (
+                block_interval
+                if block_interval is not None
+                else (conf.numRetweetBegin, conf.numRetweetEnd)
+            )
             source: Source = BlockReplayFileSource(
-                conf.replayFile,
-                num_retweet_begin=conf.numRetweetBegin,
-                num_retweet_end=conf.numRetweetEnd,
+                conf.replayFile, num_retweet_begin=begin, num_retweet_end=end
             )
             return _wrap_faults(source, conf)
         source = ReplayFileSource(conf.replayFile, speed=conf.replaySpeed)
