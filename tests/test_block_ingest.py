@@ -304,23 +304,33 @@ def test_fuzzed_unicode_parity(feat, tmp_path, ensure_ascii):
         + [chr(rng.randrange(0x10000, 0x10400)) for _ in range(10)]  # astral
         + ["\U0001f600", "\U0001f525"]
     )
+    def shuffled(d: dict) -> dict:
+        items = list(d.items())
+        rng.shuffle(items)
+        return {
+            k: shuffled(v) if isinstance(v, dict) else v for k, v in items
+        }
+
     objs = []
     for i in range(200):
         text = "".join(rng.choice(alphabet) for _ in range(rng.randrange(0, 60)))
-        objs.append({
+        objs.append(shuffled({
             "text": "RT wrap",
             "junk": {"nested": [i, None, True, {"deep": [text]}]},
+            f"unknown_{rng.randrange(10)}": rng.choice([None, True, 1.5, "s"]),
             "retweeted_status": {
                 "text": text,
                 "retweet_count": rng.randrange(0, 2000),
+                "extra": {"a": [rng.randrange(9)]},
                 "user": {
                     "followers_count": rng.randrange(0, 10**9),
                     "favourites_count": rng.randrange(0, 10**6),
                     "friends_count": rng.randrange(0, 10**5),
+                    "screen_name": "user_" + str(i),
                 },
                 "timestamp_ms": str(rng.randrange(10**12, 2 * 10**12)),
             },
-        })
+        }))
     path = tmp_path / f"fuzz_{ensure_ascii}.jsonl"
     path.write_text(
         "\n".join(json.dumps(o, ensure_ascii=ensure_ascii) for o in objs) + "\n",
