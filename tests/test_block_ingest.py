@@ -432,3 +432,32 @@ def test_kmeans_app_block_ingest_matches_object(capsys):
         ]
     assert outputs["block"] == outputs["object"]
     assert outputs["block"], "no stats lines captured"
+
+
+def test_warmup_compile_is_a_semantic_noop(capsys):
+    """Pinning both buckets pre-compiles the step on an all-padding batch:
+    weights stay at zeros and the subsequent real run is unchanged."""
+    import numpy as np
+
+    from twtml_tpu.apps import linear_regression as app
+    from twtml_tpu.config import ConfArguments
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.models import StreamingLinearRegressionWithSGD
+
+    conf = ConfArguments().parse(["--batchBucket", "8", "--tokenBucket", "64"])
+    feat = Featurizer(now_ms=1785320000000)
+    model = StreamingLinearRegressionWithSGD(num_iterations=5)
+    app.warmup_compile(conf, feat, model)
+    assert np.abs(model.latest_weights).sum() == 0.0  # no-op for the learner
+
+    conf2 = ConfArguments().parse([
+        "--source", "replay", "--replayFile", DATA,
+        "--batchBucket", "8", "--tokenBucket", "64",
+        "--lightning", "http://127.0.0.1:9", "--twtweb", "http://127.0.0.1:9",
+        "--backend", "cpu",
+    ])
+    app.run(conf2, max_batches=1)
+    lines = [
+        l for l in capsys.readouterr().out.splitlines() if l.startswith("count:")
+    ]
+    assert lines == ["count: 6  batch: 6  mse: 481105.0  stdev (real, pred): (346, 0)"]

@@ -174,3 +174,8 @@ def test_hash_on_flag_and_validation(isolated_env, tmp_path, monkeypatch):
     monkeypatch.setenv("TWTML_CONFIG", str(bad))
     with pytest.raises(ValueError):
         ConfArguments()
+
+
+def test_token_bucket_flag(isolated_env):
+    assert ConfArguments().tokenBucket == 0
+    assert ConfArguments().parse(["--tokenBucket", "128"]).tokenBucket == 128

@@ -158,3 +158,21 @@ def test_feature_stream_device_hash_wire_format():
 
     assert labels(results[True]) == [150.0, 300.0, 700.0]
     assert labels(results[False]) == labels(results[True])
+
+
+def test_bucket_overflow_warns_once(caplog):
+    """A tweet longer than the pinned tokenBucket grows the shape; the
+    stream warns once so a defeated compile warmup is visible."""
+    import logging
+
+    from twtml_tpu.streaming.context import FeatureStream
+
+    stream = FeatureStream(
+        Featurizer(now_ms=0), row_bucket=8, token_bucket=8, device_hash=True
+    )
+    long_tweet = rt(text="x" * 100)
+    with caplog.at_level(logging.WARNING, logger="twtml.streaming.context"):
+        stream._process([long_tweet], 0.0)
+        stream._process([long_tweet], 0.0)
+    warnings = [r for r in caplog.records if "overflowed" in r.message]
+    assert len(warnings) == 1

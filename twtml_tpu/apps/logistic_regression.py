@@ -28,7 +28,7 @@ from ..models.logistic import StreamingLogisticRegressionWithSGD
 from ..streaming.context import StreamingContext
 from ..telemetry.session_stats import SessionStats
 from ..utils import get_logger, round_half_up
-from .linear_regression import build_source, select_backend
+from .linear_regression import build_source, select_backend, warmup_compile
 
 log = get_logger("apps.logistic")
 
@@ -45,7 +45,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     ssc = StreamingContext(batch_interval=conf.seconds)
     stream = ssc.source_stream(
         build_source(conf, allow_block=True), featurizer,
-        row_bucket=conf.batchBucket,
+        row_bucket=conf.batchBucket, token_bucket=conf.tokenBucket,
         device_hash=conf.hashOn == "device",
     )
     totals = {"count": 0, "batches": 0}
@@ -78,6 +78,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
             ssc.request_stop()
 
     stream.foreach_batch(on_batch)
+    warmup_compile(conf, featurizer, model)
     ssc.start()
     try:
         ssc.await_termination()

@@ -115,6 +115,7 @@ class ConfArguments:
         self.replayFile: str = conf.get("replayFile", "")
         self.replaySpeed: float = float(conf.get("replaySpeed", "0.0"))
         self.batchBucket: int = int(conf.get("batchBucket", "0"))
+        self.tokenBucket: int = int(conf.get("tokenBucket", "0"))
         self.hashOn: str = conf.get("hashOn", "device")
         if self.hashOn not in ("device", "host"):
             raise ValueError(
@@ -184,6 +185,10 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
   --replayFile <path.jsonl>                    Tweet replay file (source=replay)
   --replaySpeed <float>                        0 = as-fast-as-possible, else x realtime
   --batchBucket <int>                          Pad batches up to this bucket size (0 = auto)
+  --tokenBucket <int>                          Pad per-tweet tokens/units to this bucket
+                                               (0 = auto per batch); pinning BOTH buckets
+                                               fixes the XLA program shape, enabling the
+                                               pre-stream compile warmup
   --hashOn <device|host>                       Bigram-hash featurization inside the XLA step
                                                (device, default) or on the host CPU (host);
                                                bit-identical features either way. Default: {self.hashOn}
@@ -250,6 +255,8 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
             self.replaySpeed = float(take())
         elif flag == "--batchBucket":
             self.batchBucket = int(take())
+        elif flag == "--tokenBucket":
+            self.tokenBucket = int(take())
         elif flag == "--hashOn":
             self.hashOn = take()
             if self.hashOn not in ("device", "host"):

@@ -73,6 +73,31 @@ class FeatureStream(RawStream):
         self.token_bucket = token_bucket
         self.row_multiple = row_multiple
         self.device_hash = device_hash
+        self._bucket_overflow_warned = False
+
+    def _check_buckets(self, batch) -> None:
+        """Warn (once) when a batch overflowed the pinned buckets: the
+        featurizer grows the bucket rather than truncate, so the step
+        recompiles for the bigger shape — silently defeating a pre-stream
+        compile warmup and multiplying program count."""
+        if self._bucket_overflow_warned:
+            return
+        rows = batch.mask.shape[0]
+        tokens = (
+            batch.units.shape[1]
+            if isinstance(batch, UnitBatch)
+            else batch.token_idx.shape[1]
+        )
+        over_rows = 0 < self.row_bucket < rows
+        over_tok = 0 < self.token_bucket < tokens
+        if over_rows or over_tok:
+            self._bucket_overflow_warned = True
+            log.warning(
+                "batch shape (%d, %d) overflowed the pinned buckets "
+                "(%d, %d): the step recompiles for the larger shape — "
+                "raise --batchBucket/--tokenBucket to keep one program",
+                rows, tokens, self.row_bucket, self.token_bucket,
+            )
 
     def _process(
         self, statuses: list[Status], batch_time: float
@@ -87,6 +112,7 @@ class FeatureStream(RawStream):
                 merge_blocks(statuses), row_bucket=self.row_bucket,
                 unit_bucket=self.token_bucket, row_multiple=self.row_multiple,
             )
+            self._check_buckets(batch)
             for fn in self._outputs:
                 fn(batch, batch_time)
             return batch
@@ -103,6 +129,7 @@ class FeatureStream(RawStream):
                 token_bucket=self.token_bucket,
                 row_multiple=self.row_multiple,
             )
+        self._check_buckets(batch)
         for fn in self._outputs:
             fn(batch, batch_time)
         return batch
