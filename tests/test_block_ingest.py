@@ -444,10 +444,16 @@ def test_warmup_compile_is_a_semantic_noop(capsys):
     from twtml_tpu.features.featurizer import Featurizer
     from twtml_tpu.models import StreamingLinearRegressionWithSGD
 
+    from twtml_tpu.streaming.context import FeatureStream
+
     conf = ConfArguments().parse(["--batchBucket", "8", "--tokenBucket", "64"])
     feat = Featurizer(now_ms=1785320000000)
     model = StreamingLinearRegressionWithSGD(num_iterations=5)
-    app.warmup_compile(conf, feat, model)
+    stream = FeatureStream(
+        feat, row_bucket=conf.batchBucket, token_bucket=conf.tokenBucket,
+        device_hash=True,
+    )
+    app.warmup_compile(conf, stream, model)
     assert np.abs(model.latest_weights).sum() == 0.0  # no-op for the learner
 
     conf2 = ConfArguments().parse([

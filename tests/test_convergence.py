@@ -80,3 +80,53 @@ def test_sharded_receivers_feed_one_stream():
     multi.stop()
     assert multi.exhausted
     assert len(got) == 100  # 4 shards × 25 tweets, all delivered
+
+
+def test_rmse_curve_identical_across_ingest_modes(tmp_path):
+    """Streaming 8 micro-batches from a FILE with weights carried across
+    batches: the object path and the native block path must produce the
+    SAME per-batch MSE curve — the 'identical RMSE curves' acceptance bar
+    (BASELINE.md north star) applied to the ingest modes."""
+    import json
+
+    from tools.bench_suite import _status_json
+    from twtml_tpu.features.blocks import merge_blocks
+    from twtml_tpu.streaming.sources import BlockReplayFileSource
+
+    statuses = list(SyntheticSource(total=2048, seed=11).produce())
+    path = tmp_path / "stream.jsonl"
+    with open(path, "w") as fh:
+        for s in statuses:
+            fh.write(json.dumps(_status_json(s)) + "\n")
+
+    feat = Featurizer(now_ms=1785320000000)
+    B = 256
+
+    model_o = StreamingLinearRegressionWithSGD(num_iterations=10)
+    curve_o = []
+    for i in range(0, 2048, B):
+        out = model_o.step(feat.featurize_batch_units(
+            statuses[i : i + B], row_bucket=B, unit_bucket=64,
+            pre_filtered=True,
+        ))
+        curve_o.append(float(out.mse))
+
+    block = merge_blocks(list(BlockReplayFileSource(str(path)).produce()))
+    assert block.rows == 2048
+    model_b = StreamingLinearRegressionWithSGD(num_iterations=10)
+    curve_b = []
+    for i in range(0, 2048, B):
+        sub = type(block)(
+            block.numeric[i : i + B],
+            block.units[block.offsets[i] : block.offsets[i + B]],
+            block.offsets[i : i + B + 1] - block.offsets[i],
+            block.ascii[i : i + B],
+        )
+        out = model_b.step(feat.featurize_parsed_block(
+            sub, row_bucket=B, unit_bucket=64
+        ))
+        curve_b.append(float(out.mse))
+
+    assert len(curve_o) == 8
+    np.testing.assert_allclose(curve_o, curve_b, rtol=1e-6)
+    assert curve_o[-1] < curve_o[0]  # it actually learns along the curve

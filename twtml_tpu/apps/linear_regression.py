@@ -144,12 +144,14 @@ def build_model(conf):
     return StreamingLinearRegressionWithSGD.from_conf(conf), 1
 
 
-def warmup_compile(conf, featurizer, model, row_multiple: int = 1) -> None:
+def warmup_compile(conf, stream, model) -> None:
     """Pre-compile the step for the known batch shape BEFORE the stream
     starts, so the first wall-clock micro-batch doesn't swallow the whole
     compile-time backlog (~30 s on a cold TPU chip, during which a live
     source keeps producing). Only possible when --batchBucket AND
-    --tokenBucket pin the full XLA program shape; an all-padding batch is
+    --tokenBucket pin the full XLA program shape. The warm batch comes from
+    the stream's OWN featurize dispatch (``featurize_empty``) so it compiles
+    exactly the program the stream will run; an all-padding batch is
     semantically a no-op for the learner (zero-sample iterations leave
     weights untouched)."""
     if conf.batchBucket <= 0 or conf.tokenBucket <= 0:
@@ -157,18 +159,7 @@ def warmup_compile(conf, featurizer, model, row_multiple: int = 1) -> None:
     import time as _time
 
     t0 = _time.perf_counter()
-    # (--ingest block implies hashOn == "device" — build_source enforces it)
-    if conf.hashOn == "device":
-        warm = featurizer.featurize_batch_units(
-            [], row_bucket=conf.batchBucket, unit_bucket=conf.tokenBucket,
-            row_multiple=row_multiple,
-        )
-    else:
-        warm = featurizer.featurize_batch(
-            [], row_bucket=conf.batchBucket, token_bucket=conf.tokenBucket,
-            row_multiple=row_multiple,
-        )
-    model.step(warm)
+    model.step(stream.featurize_empty())
     log.info(
         "pre-compiled the train step for buckets (%d, %d) in %.1fs",
         conf.batchBucket, conf.tokenBucket, _time.perf_counter() - t0,
@@ -253,7 +244,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
 
     stream.foreach_batch(on_batch)
 
-    warmup_compile(conf, featurizer, model, row_multiple)
+    warmup_compile(conf, stream, model)
 
     log.info("Starting the streaming computation...")
     tracer.start()

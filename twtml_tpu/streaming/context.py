@@ -82,13 +82,22 @@ class FeatureStream(RawStream):
         compile warmup and multiplying program count."""
         if self._bucket_overflow_warned:
             return
+        from ..features.batch import pad_row_count
+
         rows = batch.mask.shape[0]
         tokens = (
             batch.units.shape[1]
             if isinstance(batch, UnitBatch)
             else batch.token_idx.shape[1]
         )
-        over_rows = 0 < self.row_bucket < rows
+        # the pinned row shape includes the mesh-divisibility round-up
+        # (row_multiple), exactly like the batches the featurizer emits
+        pinned_rows = (
+            pad_row_count(0, self.row_bucket, self.row_multiple)
+            if self.row_bucket > 0
+            else 0
+        )
+        over_rows = 0 < pinned_rows < rows
         over_tok = 0 < self.token_bucket < tokens
         if over_rows or over_tok:
             self._bucket_overflow_warned = True
@@ -99,36 +108,43 @@ class FeatureStream(RawStream):
                 rows, tokens, self.row_bucket, self.token_bucket,
             )
 
-    def _process(
-        self, statuses: list[Status], batch_time: float
-    ) -> "FeatureBatch | UnitBatch":
+    def _featurize(self, statuses: list) -> "FeatureBatch | UnitBatch":
+        """The ONE featurize dispatch for this stream's configuration —
+        shared by the per-batch path and ``featurize_empty`` so a compile
+        warmup always warms exactly the program the stream will run."""
         from ..features.blocks import ParsedBlock, merge_blocks
 
         if statuses and isinstance(statuses[0], ParsedBlock):
             # native block ingest: items are pre-filtered columnar blocks
             # (sources.BlockReplayFileSource); featurize without per-tweet
             # Python objects
-            batch = self.featurizer.featurize_parsed_block(
+            return self.featurizer.featurize_parsed_block(
                 merge_blocks(statuses), row_bucket=self.row_bucket,
                 unit_bucket=self.token_bucket, row_multiple=self.row_multiple,
             )
-            self._check_buckets(batch)
-            for fn in self._outputs:
-                fn(batch, batch_time)
-            return batch
         if self.device_hash:
             # ship raw code units; the learner hashes bigrams on device
             # (ops/text_hash.py) — bit-identical features, ~2x host headroom
-            batch = self.featurizer.featurize_batch_units(
+            return self.featurizer.featurize_batch_units(
                 statuses, row_bucket=self.row_bucket,
                 unit_bucket=self.token_bucket, row_multiple=self.row_multiple,
             )
-        else:
-            batch = self.featurizer.featurize_batch(
-                statuses, row_bucket=self.row_bucket,
-                token_bucket=self.token_bucket,
-                row_multiple=self.row_multiple,
-            )
+        return self.featurizer.featurize_batch(
+            statuses, row_bucket=self.row_bucket,
+            token_bucket=self.token_bucket,
+            row_multiple=self.row_multiple,
+        )
+
+    def featurize_empty(self) -> "FeatureBatch | UnitBatch":
+        """An all-padding batch of this stream's exact configured shape
+        (meaningful when both buckets are pinned) — for pre-stream compile
+        warmup."""
+        return self._featurize([])
+
+    def _process(
+        self, statuses: list[Status], batch_time: float
+    ) -> "FeatureBatch | UnitBatch":
+        batch = self._featurize(statuses)
         self._check_buckets(batch)
         for fn in self._outputs:
             fn(batch, batch_time)
