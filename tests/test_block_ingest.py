@@ -453,7 +453,7 @@ def test_warmup_compile_is_a_semantic_noop(capsys):
         feat, row_bucket=conf.batchBucket, token_bucket=conf.tokenBucket,
         device_hash=True,
     )
-    app.warmup_compile(conf, stream, model)
+    app.warmup_compile(stream, model)
     assert np.abs(model.latest_weights).sum() == 0.0  # no-op for the learner
 
     conf2 = ConfArguments().parse([
@@ -467,3 +467,20 @@ def test_warmup_compile_is_a_semantic_noop(capsys):
         l for l in capsys.readouterr().out.splitlines() if l.startswith("count:")
     ]
     assert lines == ["count: 6  batch: 6  mse: 481105.0  stdev (real, pred): (346, 0)"]
+
+
+def test_empty_warmup_batch_matches_block_batch_shape(feat):
+    """The shape contract warmup relies on in block mode: with the same
+    pinned buckets, featurize_batch_units([]) (what featurize_empty emits)
+    and featurize_parsed_block (what the stream emits) compile the SAME
+    jit program — identical pytree structure, shapes, and dtypes."""
+    import jax
+
+    src = BlockReplayFileSource(DATA)
+    real = feat.featurize_parsed_block(
+        merge_blocks(list(src.produce())), row_bucket=16, unit_bucket=128
+    )
+    warm = feat.featurize_batch_units([], row_bucket=16, unit_bucket=128)
+    assert jax.tree_util.tree_structure(warm) == jax.tree_util.tree_structure(real)
+    for w, r in zip(warm, real):
+        assert w.shape == r.shape and w.dtype == r.dtype

@@ -144,17 +144,18 @@ def build_model(conf):
     return StreamingLinearRegressionWithSGD.from_conf(conf), 1
 
 
-def warmup_compile(conf, stream, model) -> None:
+def warmup_compile(stream, model) -> None:
     """Pre-compile the step for the known batch shape BEFORE the stream
     starts, so the first wall-clock micro-batch doesn't swallow the whole
     compile-time backlog (~30 s on a cold TPU chip, during which a live
     source keeps producing). Only possible when --batchBucket AND
-    --tokenBucket pin the full XLA program shape. The warm batch comes from
-    the stream's OWN featurize dispatch (``featurize_empty``) so it compiles
-    exactly the program the stream will run; an all-padding batch is
-    semantically a no-op for the learner (zero-sample iterations leave
+    --tokenBucket pin the full XLA program shape (read from the stream's
+    own configuration — the single source of truth). The warm batch comes
+    from the stream's OWN featurize dispatch (``featurize_empty``) so it
+    compiles exactly the program the stream will run; an all-padding batch
+    is semantically a no-op for the learner (zero-sample iterations leave
     weights untouched)."""
-    if conf.batchBucket <= 0 or conf.tokenBucket <= 0:
+    if stream.row_bucket <= 0 or stream.token_bucket <= 0:
         return
     import time as _time
 
@@ -162,7 +163,7 @@ def warmup_compile(conf, stream, model) -> None:
     model.step(stream.featurize_empty())
     log.info(
         "pre-compiled the train step for buckets (%d, %d) in %.1fs",
-        conf.batchBucket, conf.tokenBucket, _time.perf_counter() - t0,
+        stream.row_bucket, stream.token_bucket, _time.perf_counter() - t0,
     )
 
 
@@ -244,7 +245,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
 
     stream.foreach_batch(on_batch)
 
-    warmup_compile(conf, stream, model)
+    warmup_compile(stream, model)
 
     log.info("Starting the streaming computation...")
     tracer.start()

@@ -78,7 +78,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
             ssc.request_stop()
 
     stream.foreach_batch(on_batch)
-    warmup_compile(conf, stream, model)
+    warmup_compile(stream, model)
     ssc.start()
     try:
         ssc.await_termination()

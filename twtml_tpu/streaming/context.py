@@ -74,6 +74,13 @@ class FeatureStream(RawStream):
         self.row_multiple = row_multiple
         self.device_hash = device_hash
         self._bucket_overflow_warned = False
+        # the pinned row shape includes the mesh-divisibility round-up,
+        # matching every batch the featurizer emits; fixed at construction
+        from ..features.batch import pad_row_count
+
+        self._pinned_rows = (
+            pad_row_count(0, row_bucket, row_multiple) if row_bucket > 0 else 0
+        )
 
     def _check_buckets(self, batch) -> None:
         """Warn (once) when a batch overflowed the pinned buckets: the
@@ -82,22 +89,13 @@ class FeatureStream(RawStream):
         compile warmup and multiplying program count."""
         if self._bucket_overflow_warned:
             return
-        from ..features.batch import pad_row_count
-
         rows = batch.mask.shape[0]
         tokens = (
             batch.units.shape[1]
             if isinstance(batch, UnitBatch)
             else batch.token_idx.shape[1]
         )
-        # the pinned row shape includes the mesh-divisibility round-up
-        # (row_multiple), exactly like the batches the featurizer emits
-        pinned_rows = (
-            pad_row_count(0, self.row_bucket, self.row_multiple)
-            if self.row_bucket > 0
-            else 0
-        )
-        over_rows = 0 < pinned_rows < rows
+        over_rows = 0 < self._pinned_rows < rows
         over_tok = 0 < self.token_bucket < tokens
         if over_rows or over_tok:
             self._bucket_overflow_warned = True
