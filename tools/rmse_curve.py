@@ -68,9 +68,14 @@ def main(argv=None) -> None:
     sink = open(out_path, "w", encoding="utf-8") if out_path else sys.stdout
     count = 0
     t0 = time.perf_counter()
+    featurize = (
+        featurizer.featurize_batch_units
+        if conf.hashOn == "device"
+        else featurizer.featurize_batch
+    )
     for k in range(0, len(statuses), batch_size):
         chunk = statuses[k : k + batch_size]
-        batch = featurizer.featurize_batch(
+        batch = featurize(
             chunk, row_bucket=batch_size, pre_filtered=pre_filtered,
             row_multiple=row_multiple,
         )
