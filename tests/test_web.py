@@ -156,3 +156,26 @@ def test_http_post_broadcasts_to_websockets(server):
                 assert frame["jsonClass"] == "Stats" and frame["count"] == 11
 
     asyncio.run(scenario())
+
+
+def test_static_handler_rejects_traversal_and_absolute_paths(server):
+    """GET //etc/passwd must never serve outside the assets root: pathlib
+    joinpath with an absolute segment DISCARDS the base path entirely
+    (and 'D:' does the same on Windows; control chars must 404, not 500)."""
+    import urllib.error
+    import urllib.request
+
+    _, base, _ = server
+    ok = urllib.request.urlopen(f"{base}/js/api.js", timeout=3)
+    assert ok.status == 200
+    for evil in (
+        "//etc/passwd", "//root/.ssh/id_rsa", "/a//b", "/a/./b",
+        "/D:/secrets.txt", "/js/%00x",
+    ):
+        try:
+            resp = urllib.request.urlopen(base + evil, timeout=3)
+            body = resp.read()
+            assert b"root:" not in body, f"{evil} leaked a system file"
+            raise AssertionError(f"{evil} unexpectedly served ({resp.status})")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 404, f"{evil} -> {exc.code}, want 404"

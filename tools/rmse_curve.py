@@ -31,7 +31,6 @@ def main(argv=None) -> None:
     args = list(sys.argv[1:] if argv is None else argv)
     tweets, batch_size, out_path = 50_000, 2048, ""
     rest: list[str] = []
-    it = iter(range(len(args)))
     i = 0
     while i < len(args):
         if args[i] == "--tweets":

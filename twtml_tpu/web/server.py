@@ -104,9 +104,23 @@ class Server:
         return self._static_file(rel)
 
     def _static_file(self, rel: str) -> web.StreamResponse:
-        if ".." in rel:
+        # join segment-by-segment with every segment vetted: a single
+        # joinpath("/abs/path") would DISCARD the assets base entirely
+        # (pathlib semantics; "D:" does the same on Windows) and serve
+        # arbitrary filesystem paths. Control chars (e.g. %00) would raise
+        # from is_file() → 500; they 404 here instead.
+        parts = rel.split("/")
+        if any(
+            p in ("", ".", "..")
+            or "\\" in p
+            or ":" in p
+            or any(ord(c) < 32 for c in p)
+            for p in parts
+        ):
             raise web.HTTPNotFound
-        target = self._assets.joinpath(rel)
+        target = self._assets
+        for p in parts:
+            target = target.joinpath(p)
         if not target.is_file():
             raise web.HTTPNotFound
         ctype, _ = mimetypes.guess_type(rel)
