@@ -9,7 +9,11 @@ mesh-sharded training step. Prints one JSON line with the step stats and
 final weights.
 
 Usage: python tests/distributed_worker.py <process_id> <num_processes> \
-           <coordinator_port> <wire_format: unit|host>
+           <coordinator_port> <wire_format: unit|host> [mesh: 1d|2d]
+
+``2d`` builds a (data=2, model=2) mesh over the 4 global devices — the
+feature-sharded weight layout spanning PROCESS boundaries (each process
+holds half of each weight shard pair).
 """
 
 from __future__ import annotations
@@ -31,6 +35,7 @@ def main() -> None:
     pid, nprocs, port, wire = (
         int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
     )
+    mesh_kind = sys.argv[5] if len(sys.argv) > 5 else "1d"
     jax.distributed.initialize(
         f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid
     )
@@ -38,25 +43,50 @@ def main() -> None:
     import numpy as np
 
     from twtml_tpu.features.featurizer import Featurizer
-    from twtml_tpu.parallel import ParallelSGDModel, make_mesh
+    from twtml_tpu.parallel import ParallelSGDModel, make_mesh, shard_batch
     from twtml_tpu.parallel.distributed import host_local_batch_to_global
     from twtml_tpu.streaming.sources import SyntheticSource
 
-    statuses = list(SyntheticSource(total=64, seed=7).produce())
-    local = statuses[pid::nprocs]  # this host's stream shard
+    # base_ms pinned: the 2d topology device_puts the SAME global batch from
+    # every process, which demands bit-identical featurization
+    statuses = list(
+        SyntheticSource(total=64, seed=7, base_ms=1785320000000).produce()
+    )
     feat = Featurizer(now_ms=1785320000000)
-    if wire == "unit":
-        batch = feat.featurize_batch_units(
-            local, row_bucket=16, unit_bucket=64, pre_filtered=True
-        )
-    else:
-        batch = feat.featurize_batch(
-            local, row_bucket=16, token_bucket=64, pre_filtered=True
+
+    def featurize(sts):
+        if wire == "unit":
+            return feat.featurize_batch_units(
+                sts, row_bucket=len(sts), unit_bucket=64, pre_filtered=True
+            )
+        return feat.featurize_batch(
+            sts, row_bucket=len(sts), token_bucket=64, pre_filtered=True
         )
 
-    mesh = make_mesh(num_data=len(jax.devices()), devices=jax.devices())
-    global_batch = host_local_batch_to_global(batch, mesh)
-    model = ParallelSGDModel(mesh, num_iterations=5, step_size=0.005)
+    if mesh_kind == "2d":
+        # arrange devices so the MODEL axis pairs devices from DIFFERENT
+        # processes: jax.devices() is process-major [p0d0,p0d1,p1d0,p1d1];
+        # ordering [p0d0,p1d0,p0d1,p1d1] makes each mesh row mix processes —
+        # the model-axis psum rides the cross-process (DCN-analog) path and
+        # each weight shard is NOT fully addressable from one process
+        # (exercising the latest_weights allgather). With this topology the
+        # DATA shards span both processes too, so per-host intake sharding
+        # doesn't apply: every host supplies the full batch (device_put
+        # places each device's local shard from it).
+        d = jax.devices()
+        mesh = make_mesh(
+            num_data=2, num_model=2, devices=[d[0], d[2], d[1], d[3]]
+        )
+        model = ParallelSGDModel(
+            mesh, num_text_features=1000, num_iterations=5, step_size=0.005
+        )
+        global_batch = shard_batch(featurize(statuses), mesh)
+    else:
+        mesh = make_mesh(num_data=len(jax.devices()), devices=jax.devices())
+        model = ParallelSGDModel(mesh, num_iterations=5, step_size=0.005)
+        local = statuses[pid::nprocs]  # this host's stream shard
+        batch = featurize(local)
+        global_batch = host_local_batch_to_global(batch, mesh)
     out = model.step(global_batch)
     print(json.dumps({
         "process": pid,

@@ -32,12 +32,14 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_group(wire: str, nprocs: int = 2, timeout: float = 180.0):
+def _run_group(
+    wire: str, nprocs: int = 2, timeout: float = 180.0, mesh: str = "1d"
+):
     port = _free_port()
     env = dict(os.environ, PYTHONPATH=REPO)
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, str(i), str(nprocs), str(port), wire],
+            [sys.executable, WORKER, str(i), str(nprocs), str(port), wire, mesh],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
         )
         for i in range(nprocs)
@@ -62,7 +64,9 @@ def _single_process_expectation(wire: str):
     from twtml_tpu.models import StreamingLinearRegressionWithSGD
     from twtml_tpu.streaming.sources import SyntheticSource
 
-    statuses = list(SyntheticSource(total=64, seed=7).produce())
+    statuses = list(
+        SyntheticSource(total=64, seed=7, base_ms=1785320000000).produce()
+    )
     feat = Featurizer(now_ms=1785320000000)
     shards = []
     for pid in range(2):
@@ -100,3 +104,18 @@ def test_two_process_group_trains_in_lockstep(wire):
     np.testing.assert_allclose(
         outs[0]["weights"], weights, rtol=1e-4, atol=1e-7
     )
+
+
+def test_two_process_2d_mesh_feature_sharding():
+    """(data=2, model=2) mesh across TWO processes with the model axis
+    deliberately pairing devices from DIFFERENT processes: the per-iteration
+    feature-shard psum crosses the process boundary (the DCN-analog path),
+    each weight shard is not fully addressable from one process (the
+    latest_weights allgather), and the result still matches the
+    single-process ground truth."""
+    outs = _run_group("unit", mesh="2d")
+    assert outs[0]["count"] == outs[1]["count"] == 64.0
+    np.testing.assert_allclose(outs[0]["weights"], outs[1]["weights"], rtol=1e-6)
+    count, mse, weights = _single_process_expectation("unit")
+    assert outs[0]["mse"] == pytest.approx(mse, rel=1e-4)
+    np.testing.assert_allclose(outs[0]["weights"], weights, rtol=1e-4, atol=1e-7)

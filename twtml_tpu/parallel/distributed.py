@@ -65,7 +65,15 @@ def host_local_batch_to_global(
     """Assemble each host's locally-featurized rows into one global
     row-sharded batch (multi-host stream sharding), for either wire format
     (host-hashed tokens or raw code units). Single-process: no-op beyond
-    device placement."""
+    device placement.
+
+    Topology requirement: per-host intake sharding assumes the mesh's data
+    axis is PROCESS-ALIGNED (each data shard's devices belong to one
+    process) — the default `make_mesh` over process-major `jax.devices()`
+    satisfies this. A mesh whose model axis crosses processes makes every
+    host's devices hold rows of every data shard; such layouts must ship
+    the full batch from each host via `shard_batch` instead (see
+    tests/distributed_worker.py's 2d mode)."""
     from jax.sharding import NamedSharding
 
     from .sharding import _pspecs_for

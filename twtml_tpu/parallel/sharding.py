@@ -287,13 +287,30 @@ class ParallelSGDModel:
         kwargs.update(overrides)
         return cls(mesh, **kwargs)
 
+    @staticmethod
+    def _to_host(arr) -> np.ndarray:
+        """Global array → host numpy, gathering across processes when this
+        process doesn't address every shard (a multi-host mesh whose model
+        axis crosses process boundaries) — required for checkpointing and
+        telemetry of feature-sharded weights on pods."""
+        if (
+            isinstance(arr, jax.Array)
+            and not arr.is_fully_addressable
+            and not arr.is_fully_replicated  # replicated: local copy suffices
+        ):
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+        return np.asarray(arr)
+
     @property
     def latest_weights(self) -> np.ndarray:
         if isinstance(self._weights, dict):
             return np.concatenate(
-                [np.asarray(self._weights["text"]), np.asarray(self._weights["num"])]
+                [self._to_host(self._weights["text"]),
+                 self._to_host(self._weights["num"])]
             )
-        return np.asarray(self._weights)
+        return self._to_host(self._weights)
 
     def set_initial_weights(self, weights) -> "ParallelSGDModel":
         weights = np.asarray(weights, dtype=self.dtype)

@@ -251,11 +251,22 @@ class SyntheticSource(Source):
         "scale batch online model predict train news data"
     ).split()
 
-    def __init__(self, total: int = 0, rate: float = 0.0, seed: int = 0, **kw):
+    def __init__(
+        self,
+        total: int = 0,
+        rate: float = 0.0,
+        seed: int = 0,
+        base_ms: int | None = None,
+        **kw,
+    ):
         super().__init__(**kw)
         self.total = total
         self.rate = rate
         self.seed = seed
+        # created_at base: wall clock by default; pin it for BIT-exact
+        # reproducibility across processes/runs (multi-host assembly
+        # requires every process to build identical global batches)
+        self.base_ms = base_ms
 
     def produce(self) -> Iterator[Status]:
         import numpy as np
@@ -278,7 +289,11 @@ class SyntheticSource(Source):
                 followers_count=followers,
                 favourites_count=int(rng.integers(0, 50_000)),
                 friends_count=int(rng.integers(0, 10_000)),
-                created_at_ms=int(time.time() * 1000) - int(rng.integers(0, 86_400_000)),
+                created_at_ms=(
+                    self.base_ms
+                    if self.base_ms is not None
+                    else int(time.time() * 1000)
+                ) - int(rng.integers(0, 86_400_000)),
             )
             yield Status(text="RT " + text, retweeted_status=original)
             count += 1
