@@ -484,3 +484,36 @@ def test_empty_warmup_batch_matches_block_batch_shape(feat):
     assert jax.tree_util.tree_structure(warm) == jax.tree_util.tree_structure(real)
     for w, r in zip(warm, real):
         assert w.shape == r.shape and w.dtype == r.dtype
+
+
+def test_fault_injection_counts_tweets_in_blocks():
+    """--faultEvery counts TWEETS for block sources too (a block is ~2000
+    rows; counting items would make faults thousands of times rarer), and a
+    threshold crossed INSIDE a stream's only block still fires — the
+    crossing block is lost in flight, like a dropped socket."""
+    from twtml_tpu.streaming.faults import FaultInjectingSource, InjectedFault
+
+    def drain(block_bytes):
+        src = FaultInjectingSource(
+            BlockReplayFileSource(DATA, block_bytes=block_bytes),
+            crash_every=3,  # fixture has 6 kept retweets
+            max_crashes=1,
+        )
+        rows, crashed = 0, False
+        it = src.produce()
+        while True:
+            try:
+                rows += next(it).rows
+            except InjectedFault:
+                crashed = True
+                break
+            except StopIteration:
+                break
+        return rows, crashed
+
+    # single block holding all 6 tweets: the threshold is inside it
+    rows, crashed = drain(1 << 20)
+    assert crashed and rows == 0
+    # several small blocks: crash still keyed to the tweet count
+    rows, crashed = drain(256)
+    assert crashed and rows < 6

@@ -14,7 +14,6 @@ from __future__ import annotations
 import random
 from typing import Iterator
 
-from ..features.featurizer import Status
 from ..utils import get_logger
 from .sources import Source
 
@@ -50,35 +49,46 @@ class FaultInjectingSource(Source):
         # sensible for unbounded sources).
         self.max_crashes = max_crashes
         self._rng = random.Random(seed)
-        self._emitted = 0
+        self._emitted = 0  # TWEETS emitted (a columnar block counts its rows)
+        self._next_crash = crash_every
         self.crashes = 0
 
     def _may_crash(self) -> bool:
         return self.max_crashes <= 0 or self.crashes < self.max_crashes
 
-    def produce(self) -> Iterator[Status]:
-        for status in self.inner.produce():
+    def produce(self) -> Iterator:
+        from ..features.blocks import ParsedBlock
+
+        for item in self.inner.produce():
+            # crash_every counts TWEETS on every source kind: block sources
+            # emit ParsedBlocks of ~thousands of rows each, so item-counting
+            # would make --faultEvery thousands of times rarer than asked
+            size = item.rows if isinstance(item, ParsedBlock) else 1
+            if self.crash_prob and self._may_crash():
+                # per-tweet probability, scaled to the item's row count
+                p = 1.0 - (1.0 - self.crash_prob) ** size
+                if self._rng.random() < p:
+                    self.crashes += 1
+                    raise InjectedFault(
+                        f"injected probabilistic crash #{self.crashes}"
+                    )
+            # count first, then crash BEFORE the yield: the item that
+            # crosses the threshold is lost in flight (like a dropped
+            # socket), and a threshold crossed inside a stream's final
+            # block still fires
+            self._emitted += size
             if (
                 self.crash_every
-                and self._emitted
-                and self._emitted % self.crash_every == 0
+                and self._emitted >= self._next_crash
                 and self._may_crash()
             ):
-                self._emitted += 1
                 self.crashes += 1
+                self._next_crash = self._emitted + self.crash_every
                 raise InjectedFault(
                     f"injected receiver crash #{self.crashes} "
-                    f"after {self._emitted - 1} tweets"
+                    f"after {self._emitted} tweets"
                 )
-            if (
-                self.crash_prob
-                and self._may_crash()
-                and self._rng.random() < self.crash_prob
-            ):
-                self.crashes += 1
-                raise InjectedFault(f"injected probabilistic crash #{self.crashes}")
-            self._emitted += 1
-            yield status
+            yield item
 
     def stop(self) -> None:
         # unblock the inner source first: our producer thread may be parked
