@@ -176,3 +176,42 @@ def test_bucket_overflow_warns_once(caplog):
         stream._process([long_tweet], 0.0)
     warnings = [r for r in caplog.records if "overflowed" in r.message]
     assert len(warnings) == 1
+
+
+def test_steady_state_stream_compiles_exactly_once():
+    """Shape discipline guard: with pinned buckets, N same-shaped batches
+    must reuse ONE compiled train-step program — recompile churn is this
+    design's key perf regression class (SURVEY.md §7 hard part (a))."""
+    import logging
+
+    from twtml_tpu.models import StreamingLinearRegressionWithSGD
+
+    compiles: list[str] = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            msg = record.getMessage()
+            if "Finished XLA compilation" in msg:
+                compiles.append(msg)
+
+    handler = Capture()
+    logger = logging.getLogger("jax._src.dispatch")
+    prev_level = logger.level
+    logger.addHandler(handler)
+    # DEBUG on this logger is sufficient to receive the compile records;
+    # the global jax_log_compiles flag is deliberately left untouched
+    logger.setLevel(logging.DEBUG)
+    try:
+        feat = Featurizer(now_ms=0)
+        model = StreamingLinearRegressionWithSGD(num_iterations=5)
+        for i in range(6):
+            batch = feat.featurize_batch_units(
+                [rt(label=100 + i, text=f"steady state tweet {i} " * (i + 1))],
+                row_bucket=8, unit_bucket=128, pre_filtered=True,
+            )
+            model.step(batch)
+        step_compiles = [m for m in compiles if "train_step" in m]
+        assert len(step_compiles) == 1, step_compiles
+    finally:
+        logger.removeHandler(handler)
+        logger.setLevel(prev_level)
