@@ -129,3 +129,30 @@ def test_shard_batch_placement():
     batch = make_batch()
     sharded = shard_batch(batch, mesh)
     assert sharded.label.sharding.spec == jax.sharding.PartitionSpec("data")
+
+
+def test_feature_sharded_2e18_unit_batch():
+    """BASELINE config #4 at full scale on the mesh: 2^18 text dims sharded
+    over 'model', fed the default wire format (raw units, device hashing),
+    must match the single-device run up to float reduction order."""
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.streaming.sources import SyntheticSource
+
+    statuses = list(
+        SyntheticSource(total=64, seed=3, base_ms=1785320000000).produce()
+    )
+    feat = Featurizer(num_text_features=2**18, now_ms=1785320000000)
+    batch = feat.featurize_batch_units(statuses, row_bucket=64, pre_filtered=True)
+    mesh = make_mesh(num_data=4, num_model=2)
+    par = ParallelSGDModel(
+        mesh, num_text_features=2**18, num_iterations=5, step_size=0.005
+    )
+    single = StreamingLinearRegressionWithSGD(
+        num_text_features=2**18, num_iterations=5
+    )
+    out = par.step(batch)
+    out_single = single.step(batch)
+    assert float(out.mse) == pytest.approx(float(out_single.mse), rel=1e-4)
+    np.testing.assert_allclose(
+        par.latest_weights, single.latest_weights, rtol=1e-4, atol=1e-7
+    )
