@@ -95,6 +95,33 @@ def test_source_gives_up_after_max_restarts():
     src.stop()
 
 
+def test_max_restarts_bounds_consecutive_failures_only():
+    """A run that emitted data resets the restart ladder: a long-lived
+    receiver must not die on its Nth lifetime disconnect (the live Twitter
+    source raises on every server-side stream close by design)."""
+
+    class DropsEveryTime(Source):
+        name = "droppy"
+        attempts = 0
+
+        def produce(self):
+            DropsEveryTime.attempts += 1
+            yield rt()
+            raise ConnectionError("disconnect after healthy streaming")
+
+    src = DropsEveryTime(max_restarts=2, restart_backoff=0.001)
+    got = []
+    src.start(got.append)
+    deadline = time.time() + 2
+    while len(got) < 8 and time.time() < deadline:
+        time.sleep(0.005)
+    src.stop()
+    # 8 successful emissions needs 8 connections: far more than
+    # max_restarts=2, alive because every failure followed healthy output
+    assert len(got) >= 8
+    assert not src.exhausted
+
+
 def test_replay_run_to_completion():
     src = ReplayFileSource(DATA)
     ssc = StreamingContext()

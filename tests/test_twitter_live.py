@@ -1,0 +1,311 @@
+"""Live-Twitter protocol path, exercised for real against a LOCAL server.
+
+Covers what the reference delegates to Twitter4j (TwitterUtils.createStream,
+LinearRegression.scala:44): OAuth1 HMAC-SHA1 signing (pinned by published
+external test vectors), the chunked streaming HTTP client, the v1.1
+delimited-JSON stream protocol (keep-alives, disconnects, HTTP 420), and the
+Twitter reconnect/backoff policy. No egress: the server is in-process
+http.server speaking real HTTP over loopback.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import unquote
+
+import pytest
+
+from twtml_tpu.streaming import oauth1
+from twtml_tpu.streaming.faults import FaultInjectingSource
+from twtml_tpu.streaming.httpstream import (
+    RateLimitedError,
+    StreamHTTPError,
+    open_stream,
+)
+from twtml_tpu.streaming.twitter import OAUTH_KEYS, TwitterSource
+
+# ---------------------------------------------------------------------------
+# OAuth 1.0a signing — external published vectors
+
+
+def test_rfc5849_example_signature():
+    """RFC 5849 §1.2 temporary-credentials request (no token secret)."""
+    params = [
+        ("oauth_consumer_key", "dpf43f3p2l4k3l03"),
+        ("oauth_signature_method", "HMAC-SHA1"),
+        ("oauth_timestamp", "137131200"),
+        ("oauth_nonce", "wIjqoS"),
+        ("oauth_callback", "http://printer.example.com/ready"),
+    ]
+    sig = oauth1.sign(
+        "POST", "https://photos.example.net/initiate", params,
+        consumer_secret="kd94hf93k423kf44", token_secret="",
+    )
+    assert sig == "74KNZJeDHnMBp0EMJ9ZHt/XKycU="
+
+
+def test_twitter_docs_signature_vector():
+    """The worked example from Twitter's 'Creating a signature' developer
+    doc (api.twitter.com/1.1/statuses/update.json)."""
+    params = [
+        ("status", "Hello Ladies + Gentlemen, a signed OAuth request!"),
+        ("include_entities", "true"),
+        ("oauth_consumer_key", "xvz1evFS4wEEPTGEFPHBog"),
+        ("oauth_nonce", "kYjzVBB8Y0ZFabxSWbWovY3uYSQ2pTgmZeNu2VS4cg"),
+        ("oauth_signature_method", "HMAC-SHA1"),
+        ("oauth_timestamp", "1318622958"),
+        ("oauth_token", "370773112-GmHxMAgYyLbNEtIKZeRNFsMKPR9EyMZeS9weJAEb"),
+        ("oauth_version", "1.0"),
+    ]
+    sig = oauth1.sign(
+        "POST", "https://api.twitter.com/1.1/statuses/update.json", params,
+        consumer_secret="kAcSOqF21Fu85e7zjz7ZN2U4ZRhfV3WpwPAoE3Z7kBw",
+        token_secret="LswwdoUaIvS8ltyTt5jkRh4J50vUPVVHtR2YPi5kE",
+    )
+    assert sig == "hCtSmYh+iHYCEqBWrE7C7hYmtUk="
+
+
+def test_percent_encoding_rfc3986():
+    assert oauth1.percent_encode("Ladies + Gentlemen") == "Ladies%20%2B%20Gentlemen"
+    assert oauth1.percent_encode("safe-chars_are.kept~") == "safe-chars_are.kept~"
+    assert oauth1.percent_encode("☃") == "%E2%98%83"  # UTF-8 bytes, uppercase hex
+
+
+def test_authorization_header_query_params_signed_not_emitted():
+    hdr = oauth1.authorization_header(
+        "GET", "http://example.com/stream.json?delimited=length&x=a%20b",
+        consumer_key="ck", consumer_secret="cs", token="tk", token_secret="ts",
+        nonce="fixednonce", timestamp=1700000000,
+    )
+    assert hdr.startswith("OAuth ")
+    assert "delimited" not in hdr  # query params signed but not in header
+    fields = dict(
+        p.split("=", 1) for p in hdr[len("OAuth ") :].split(", ")
+    )
+    assert fields["oauth_consumer_key"] == '"ck"'
+    assert fields["oauth_signature_method"] == '"HMAC-SHA1"'
+    # signature must cover the DECODED query values re-encoded once
+    expected = oauth1.sign(
+        "GET", "http://example.com/stream.json?delimited=length&x=a%20b",
+        [
+            ("oauth_consumer_key", "ck"),
+            ("oauth_nonce", "fixednonce"),
+            ("oauth_signature_method", "HMAC-SHA1"),
+            ("oauth_timestamp", "1700000000"),
+            ("oauth_token", "tk"),
+            ("oauth_version", "1.0"),
+            ("delimited", "length"),
+            ("x", "a b"),
+        ],
+        "cs", "ts",
+    )
+    assert unquote(fields["oauth_signature"].strip('"')) == expected
+
+
+# ---------------------------------------------------------------------------
+# Local v1.1-protocol stream server
+
+TWEETS = [
+    json.dumps({
+        "text": f"RT @u: tweet {i}",
+        "retweeted_status": {
+            "text": f"tweet {i}",
+            "retweet_count": 100 + i,
+            "user": {"followers_count": 10 * i},
+        },
+    })
+    for i in range(40)
+]
+
+
+class StreamHandler(BaseHTTPRequestHandler):
+    """Speaks the v1.1 stream shape: 200 + chunked delimited JSON with
+    keep-alive blank lines, chunk boundaries deliberately misaligned with
+    line boundaries. Behavior per path:
+
+    - /stream           : all tweets, clean end (0-chunk terminator)
+    - /drop             : half the tweets, then a hard disconnect (no
+                          terminator) — next request serves the rest
+    - /calm             : HTTP 420
+    - /forbidden        : HTTP 401
+    - /soak             : 10 tweets per connection, forever
+    """
+
+    protocol_version = "HTTP/1.1"
+    server_state: dict = {}
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _start_stream(self):
+        self.send_response(200)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+
+    def _send_raw(self, data: bytes, chunk: int = 37):
+        """Write as chunked frames of ``chunk`` bytes — misaligned with the
+        JSON lines so the client must reassemble across chunks."""
+        for i in range(0, len(data), chunk):
+            piece = data[i : i + chunk]
+            self.wfile.write(f"{len(piece):x}\r\n".encode() + piece + b"\r\n")
+        self.wfile.flush()
+
+    def do_GET(self):
+        self.server_state.setdefault("auth_headers", []).append(
+            self.headers.get("Authorization", "")
+        )
+        if self.path == "/calm":
+            self.send_response(420, "Enhance Your Calm")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        if self.path == "/forbidden":
+            self.send_response(401, "Unauthorized")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self._start_stream()
+        if self.path == "/stream":
+            body = "\r\n".join(TWEETS[:20]) + "\r\n\r\n\r\n"  # 2 keep-alives
+            self._send_raw(body.encode())
+            self.wfile.write(b"0\r\n\r\n")  # clean terminator
+        elif self.path == "/drop":
+            n = self.server_state.setdefault("drop_conns", 0)
+            self.server_state["drop_conns"] = n + 1
+            if n == 0:
+                self._send_raw(("\r\n".join(TWEETS[:10]) + "\r\n").encode())
+                # hard disconnect: no terminating chunk; abort the socket
+                self.connection.close()
+                raise ConnectionAbortedError  # stop handler, keep server
+            self._send_raw(("\r\n".join(TWEETS[10:20]) + "\r\n").encode())
+            self.wfile.write(b"0\r\n\r\n")
+        elif self.path == "/soak":
+            n = self.server_state.setdefault("soak_conns", 0)
+            self.server_state["soak_conns"] = n + 1
+            lo = (n * 10) % len(TWEETS)
+            self._send_raw(("\r\n".join(TWEETS[lo : lo + 10]) + "\r\n").encode())
+            self.wfile.write(b"0\r\n\r\n")
+        self.close_connection = True
+
+
+@pytest.fixture()
+def stream_server():
+    StreamHandler.server_state = {}
+    server = ThreadingHTTPServer(("127.0.0.1", 0), StreamHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield f"http://127.0.0.1:{server.server_address[1]}"
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+CREDS = {k: "secret-" + k.rsplit(".", 1)[1] for k in OAUTH_KEYS}
+
+
+def _collect(src: TwitterSource, expect: int, timeout: float = 15.0):
+    got = []
+    src.start(got.append)
+    deadline = time.time() + timeout
+    while len(got) < expect and not src.exhausted and time.time() < deadline:
+        time.sleep(0.01)
+    src.stop()
+    return got
+
+
+def test_real_http_stream_end_to_end(stream_server):
+    """Full native path: OAuth header → HTTP request → chunked decode →
+    line reassembly → Status parse. No connect_fn anywhere."""
+    src = TwitterSource(CREDS, url=stream_server + "/stream")
+    got = _collect(src, 20)
+    assert len(got) == 20
+    assert [s.retweeted_status.retweet_count for s in got] == list(range(100, 120))
+    # the server saw a well-formed signed Authorization header
+    auth = StreamHandler.server_state["auth_headers"][0]
+    assert auth.startswith("OAuth ")
+    for field in ("oauth_consumer_key", "oauth_nonce", "oauth_signature",
+                  "oauth_timestamp", "oauth_token", "oauth_version"):
+        assert field in auth
+
+
+def test_server_side_signature_verifies(stream_server):
+    """Recompute the signature server-side from the received header — proves
+    the header's params and the signature agree end-to-end (the signing
+    primitive itself is pinned by the external vectors above)."""
+    url = stream_server + "/stream"
+    src = TwitterSource(CREDS, url=url)
+    _collect(src, 20)
+    auth = StreamHandler.server_state["auth_headers"][0]
+    fields = {
+        k: unquote(v.strip('"'))
+        for k, v in (p.split("=", 1) for p in auth[len("OAuth ") :].split(", "))
+    }
+    claimed = fields.pop("oauth_signature")
+    recomputed = oauth1.sign(
+        "GET", url, sorted(fields.items()),
+        consumer_secret=CREDS["twitter4j.oauth.consumerSecret"],
+        token_secret=CREDS["twitter4j.oauth.accessTokenSecret"],
+    )
+    assert claimed == recomputed
+
+
+def test_disconnect_reconnects_and_resumes(stream_server):
+    """Mid-stream hard disconnect → supervisor restarts with the transport
+    backoff → second connection serves the remainder."""
+    src = TwitterSource(CREDS, url=stream_server + "/drop")
+    got = _collect(src, 20)
+    assert StreamHandler.server_state["drop_conns"] == 2
+    counts = [s.retweeted_status.retweet_count for s in got]
+    assert counts == list(range(100, 120))
+
+
+def test_http_420_raises_rate_limited(stream_server):
+    with pytest.raises(RateLimitedError) as exc:
+        list(open_stream(stream_server + "/calm"))
+    assert exc.value.status == 420
+
+
+def test_http_401_raises_stream_error(stream_server):
+    with pytest.raises(StreamHTTPError) as exc:
+        list(open_stream(stream_server + "/forbidden"))
+    assert exc.value.status == 401
+    assert not isinstance(exc.value, RateLimitedError)
+
+
+def test_backoff_policy_matches_twitter_rules():
+    src = TwitterSource(CREDS)
+    # 420: exponential from 60s
+    assert src._backoff(RateLimitedError(420), 1) == 60.0
+    assert src._backoff(RateLimitedError(420), 2) == 120.0
+    # other HTTP: exponential from 5s, cap 320
+    assert src._backoff(StreamHTTPError(503), 1) == 5.0
+    assert src._backoff(StreamHTTPError(503), 2) == 10.0
+    assert src._backoff(StreamHTTPError(503), 10) == 320.0
+    # transport: linear 250ms, cap 16s
+    assert src._backoff(ConnectionError(), 1) == 0.25
+    assert src._backoff(ConnectionError(), 4) == 1.0
+    assert src._backoff(ConnectionError(), 100) == 16.0
+
+
+def test_fault_injected_live_stream_soak(stream_server):
+    """VERDICT r1 done-criterion: fault-injected fake-stream soak. The
+    injector crashes the receiver every 17 tweets on top of the server
+    ending every connection after 10 — both recovery paths interleave."""
+    inner = TwitterSource(CREDS, url=stream_server + "/soak")
+    src = FaultInjectingSource(inner, crash_every=17, max_crashes=3)
+    got = _collect(src, 100, timeout=30.0)
+    assert len(got) >= 100
+    assert src.crashes == 3
+    assert StreamHandler.server_state["soak_conns"] >= 10
+
+
+def test_keep_alive_lines_skipped(stream_server):
+    """/stream embeds blank keep-alive lines; none become Status objects."""
+    src = TwitterSource(CREDS, url=stream_server + "/stream")
+    got = _collect(src, 20)
+    assert all(s.text for s in got)

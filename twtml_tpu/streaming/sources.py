@@ -61,30 +61,46 @@ class Source:
     def _run_supervised(self) -> None:
         restarts = 0
         while not self._stop.is_set():
+            emitted_any = False
             try:
                 for status in self.produce():
                     if self._stop.is_set():
                         return
                     self._emit(status)
+                    emitted_any = True
                 self._exhausted.set()
                 return  # clean end of stream
-            except Exception:
+            except Exception as exc:
+                if emitted_any:
+                    # a run that produced data was a healthy (re)connection:
+                    # max_restarts bounds CONSECUTIVE failures and the
+                    # backoff ladder restarts from the bottom (the Twitter
+                    # reconnect rules reset on successful connection; a
+                    # receiver that streamed for hours must not die on its
+                    # 4th lifetime disconnect)
+                    restarts = 0
                 restarts += 1
                 if restarts > self.max_restarts:
                     log.exception("source %s died permanently", self.name)
                     self._exhausted.set()
                     return
-                # cap the exponent too: restarts can reach the millions in
-                # unbounded chaos runs and 2**n overflows float conversion
-                backoff = min(
-                    self.restart_backoff * (2 ** min(restarts - 1, 12)), 30.0
-                )
+                backoff = self._backoff(exc, restarts)
                 log.exception(
                     "source %s crashed; restart %d/%d in %.1fs",
                     self.name, restarts, self.max_restarts, backoff,
                 )
                 if self._stop.wait(backoff):
                     return
+
+    def _backoff(self, exc: Exception, restarts: int) -> float:
+        """Seconds to sleep before restart ``restarts`` (1-based) after
+        ``exc``. Default: exponential from ``restart_backoff``, capped at
+        30s. Subclasses override for error-class-aware policies (the live
+        Twitter receiver distinguishes rate-limit vs HTTP vs transport
+        failures, twitter.py). The exponent is capped too: restarts can
+        reach the millions in unbounded chaos runs and 2**n overflows."""
+        del exc
+        return min(self.restart_backoff * (2 ** min(restarts - 1, 12)), 30.0)
 
     def stop(self) -> None:
         self._stop.set()

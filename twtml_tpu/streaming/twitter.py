@@ -10,10 +10,18 @@ errors raise, the supervisor restarts with exponential backoff — the upgrade
 over the reference, whose receiver restart policy was whatever Spark defaults
 did (SURVEY.md §5.3).
 
-This build environment has zero egress, so the live path is exercised in
-tests through ``connect_fn`` injection (a fake endpoint yielding canned
-lines); against the real service, OAuth1 request signing applies
-(oauth_sign_fn hook — Twitter's v1.1 streaming API contract).
+The full protocol path is native and stdlib-only: OAuth1 HMAC-SHA1 request
+signing (oauth1.py, pinned by published test vectors) over a chunked
+streaming HTTP/1.1 client (httpstream.py). The build environment has zero
+egress, so tests drive the identical code path against a LOCAL server
+speaking the v1.1 stream protocol — delimited JSON, keep-alive blank lines,
+mid-stream disconnects, HTTP 420 — in tests/test_twitter_live.py;
+``connect_fn`` injection remains for protocol-free unit tests.
+
+Reconnect policy mirrors the Twitter streaming rules the Twitter4j client
+implements: transport errors retry fast-linear (250 ms, +250 ms per attempt,
+cap 16 s); HTTP errors retry exponentially from 5 s (cap 320 s); HTTP 420
+rate limiting retries exponentially from a full minute.
 """
 
 from __future__ import annotations
@@ -24,6 +32,8 @@ from typing import Callable, Iterator
 from .. import config as _config
 from ..features.featurizer import Status
 from ..utils import get_logger
+from .httpstream import RateLimitedError, StreamHTTPError, open_stream
+from .oauth1 import authorization_header
 from .sources import Source
 
 log = get_logger("streaming.twitter")
@@ -70,15 +80,43 @@ class TwitterSource(Source):
                 + " — pass --consumerKey/--consumerSecret/--accessToken/"
                 "--accessTokenSecret or set them in application.conf"
             )
+        # twitter4j's own endpoint-override property, honored here so the
+        # full CLI path can be driven against a local v1.1-protocol server
+        kw.setdefault(
+            "url", _config.get_property("twitter4j.streamBaseURL", STREAM_URL)
+        )
         return cls(creds, **kw)
 
     def _connect(self) -> Iterator[str]:
         if self._connect_fn is not None:
             return self._connect_fn()
-        raise ConnectionError(
-            "live Twitter streaming requires network egress and OAuth1 request "
-            "signing; provide connect_fn or run with --source replay/synthetic"
+        auth = authorization_header(
+            "GET",
+            self.url,
+            consumer_key=self.credentials.get("twitter4j.oauth.consumerKey", ""),
+            consumer_secret=self.credentials.get(
+                "twitter4j.oauth.consumerSecret", ""
+            ),
+            token=self.credentials.get("twitter4j.oauth.accessToken", ""),
+            token_secret=self.credentials.get(
+                "twitter4j.oauth.accessTokenSecret", ""
+            ),
         )
+        # 90s read timeout: the stream keep-alives every ~30s, so a silent
+        # socket for 90s is a stall and must raise into the supervisor
+        return open_stream(self.url, headers={"Authorization": auth})
+
+    def _backoff(self, exc: Exception, restarts: int) -> float:
+        """Twitter streaming reconnect rules (what Twitter4j implements for
+        the reference): 420 → exponential from 60 s; other HTTP errors →
+        exponential from 5 s capped 320 s; transport errors → linear 250 ms
+        steps capped 16 s."""
+        n = min(restarts - 1, 16)
+        if isinstance(exc, RateLimitedError):
+            return min(60.0 * (2**n), 960.0)
+        if isinstance(exc, StreamHTTPError):
+            return min(5.0 * (2**n), 320.0)
+        return min(0.25 * restarts, 16.0)
 
     def produce(self) -> Iterator[Status]:
         for line in self._connect():
@@ -93,3 +131,8 @@ class TwitterSource(Source):
             if "text" not in obj:
                 continue  # delete/limit notices
             yield Status.from_json(obj)
+        if self._connect_fn is None:
+            # a live stream never ends on purpose: a server-side close is a
+            # disconnect, and the supervisor must reconnect (Twitter4j does
+            # the same). Injected test streams DO end meaningfully.
+            raise ConnectionError("stream ended by server; reconnecting")
