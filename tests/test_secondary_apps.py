@@ -72,6 +72,24 @@ def test_logistic_app_on_replay(capsys):
     assert "errRate:" in out
 
 
+def test_logistic_app_sharded_local4(capsys):
+    """--master local[4]: the logistic entry trains through the 4-way
+    sharded mesh step (VERDICT r1: every entry point scales from the CLI)."""
+    from twtml_tpu.apps.logistic_regression import run
+
+    totals = run(conf_for(["--master", "local[4]"]))
+    assert totals["count"] == 6
+    assert "errRate:" in capsys.readouterr().out
+
+
+def test_kmeans_app_sharded_local4(capsys):
+    from twtml_tpu.apps.kmeans import run
+
+    totals = run(conf_for(["--master", "local[4]"]), wall_clock=False)
+    assert totals["count"] == 8
+    assert "centers:" in capsys.readouterr().out
+
+
 class TestBatchSentiment:
     """The C lexicon scorer (native/fasthash.cpp lexicon_score_batch) must
     label exactly like the per-status Python ground truth."""

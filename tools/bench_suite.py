@@ -14,6 +14,9 @@ numbers, so every baseline is measured, not copied):
   5. sharded_dp4       — 4-way data-parallel mesh, per-shard stream +
                          in-program psum gradient reduce (config #5; virtual
                          CPU mesh when <4 real chips are attached)
+  6. sharded_dp4_logistic — the logistic learner on the same 4-way mesh
+                         (sentiment labels; non-least-squares residual
+                         through the sharded step)
 
 Each config runs in its own subprocess (clean jax backend state) and prints
 one JSON line: {"config", "tweets_per_sec", "seconds", "batches", "final_metric",
@@ -39,6 +42,7 @@ CONFIGS = [
     "logistic_sentiment",
     "hashing_2e18_l2",
     "sharded_dp4",
+    "sharded_dp4_logistic",
 ]
 
 
@@ -199,17 +203,33 @@ def run_config(name: str, n_tweets: int, batch_size: int) -> dict:
             num_text_features=2**18, l2_reg=0.1
         )
         out.update(_pipeline_rate(model, feat, statuses, batch_size))
-    elif name == "sharded_dp4":
+    elif name in ("sharded_dp4", "sharded_dp4_logistic"):
         from twtml_tpu.parallel import ParallelSGDModel, make_mesh
         from twtml_tpu.parallel.sharding import shard_batch
 
         if len(jax.devices()) < 4:
             return {**out, "skipped": "backend initialized with <4 devices"}
         mesh = make_mesh(num_data=4, devices=jax.devices()[:4])
-        model = ParallelSGDModel(mesh)
+        feat = Featurizer(now_ms=1785320000000)
+        if name == "sharded_dp4_logistic":
+            from twtml_tpu.features.sentiment import (
+                sentiment_label,
+                sentiment_labels,
+            )
+            from twtml_tpu.models import StreamingLogisticRegressionWithSGD as LR
+
+            feat.label_fn = sentiment_label
+            feat.batch_label_fn = sentiment_labels
+            model = ParallelSGDModel(
+                mesh, step_size=0.1,
+                residual_fn=LR.residual_fn, prediction_fn=LR.prediction_fn,
+                round_predictions=LR.round_predictions,
+            )
+        else:
+            model = ParallelSGDModel(mesh)
         out.update(
             _pipeline_rate(
-                model, Featurizer(now_ms=1785320000000), statuses, batch_size,
+                model, feat, statuses, batch_size,
                 row_multiple=4, shard=lambda b: shard_batch(b, mesh),
             )
         )
@@ -240,7 +260,7 @@ def main(argv=None) -> None:
 
     if child:
         real = os.environ.get("TWTML_REAL_DEVICES")
-        if child == "sharded_dp4" and (
+        if child.startswith("sharded_dp4") and (
             force_cpu or (real is not None and int(real) < 4)
         ):
             # parent saw <4 real chips (or CPU was requested): run the mesh

@@ -1,0 +1,185 @@
+"""Shared app runtime: backend selection, source construction, and mesh-aware
+model construction for every entry point.
+
+The reference's one-flag cluster story (``--master local[N]`` / cluster
+masters, ConfArguments.scala:95-98) applies to ALL its entry points because
+Spark owns the runtime. Here the equivalent lives in ``build_model``: any
+SGD-family app scales from one chip to a data-parallel device mesh by
+constructing its learner through it (apps/linear_regression.py,
+apps/logistic_regression.py; k-means has its own mesh-aware model,
+models/kmeans.py), with the CLI face unchanged.
+"""
+
+from __future__ import annotations
+
+from ..models.linear import StreamingLinearRegressionWithSGD
+from ..streaming.sources import ReplayFileSource, Source, SyntheticSource
+from ..utils import get_logger
+
+log = get_logger("apps.common")
+
+
+def select_backend(conf) -> None:
+    """--backend {auto,tpu,cpu}: auto keeps jax's platform choice (TPU when
+    attached); cpu forces the host backend (the reference's local[*] analog,
+    ConfArguments.scala:54-56)."""
+    import jax
+
+    from ..utils import set_cpu_device_count_hint
+
+    shards = conf.local_shards()
+    if shards:
+        # honor the local[N] hint before any backend initialization; it only
+        # affects the CPU platform, so it's harmless when TPU wins auto
+        if not set_cpu_device_count_hint(shards):
+            log.warning("backend already initialized; local[%d] hint dropped", shards)
+    if conf.backend == "cpu":
+        # jax_platforms silently no-ops when a backend is already live, so
+        # verify the outcome instead of guessing the pre-state (and this
+        # first jax.default_backend() call initializes cpu when it did work)
+        jax.config.update("jax_platforms", "cpu")
+        if jax.default_backend() != "cpu":
+            raise RuntimeError(
+                "--backend cpu requested but a non-cpu backend is already "
+                "initialized in this process"
+            )
+    elif conf.backend == "tpu":
+        kinds = {d.platform for d in jax.devices()}
+        if "cpu" in kinds and len(kinds) == 1:
+            raise RuntimeError("--backend tpu requested but only CPU devices present")
+
+
+def build_source(
+    conf,
+    allow_block: bool = False,
+    block_interval: "tuple[int, int] | None" = None,
+) -> Source:
+    """``allow_block``: set by entry points whose pipelines consume
+    ParsedBlocks (linear: default labels; logistic: unit_label_fn; k-means:
+    numeric columns, which passes ``block_interval`` to override the
+    parser's retweet-count filter — it keeps ALL retweets)."""
+    if conf.ingest == "block" and not allow_block:
+        raise SystemExit(
+            "--ingest block is not wired for this entry point; "
+            "use --ingest object"
+        )
+    if conf.ingest == "block" and conf.source != "replay":
+        raise SystemExit("--ingest block requires --source replay")
+    if conf.source == "replay":
+        if not conf.replayFile:
+            raise SystemExit("--source replay requires --replayFile <path.jsonl>")
+        if conf.ingest == "block":
+            from ..streaming.sources import BlockReplayFileSource
+
+            if conf.replaySpeed:
+                raise SystemExit(
+                    "--ingest block replays as fast as possible; "
+                    "drop --replaySpeed or use --ingest object"
+                )
+            if conf.hashOn != "device":
+                raise SystemExit(
+                    "--ingest block ships raw code units (device hashing); "
+                    "--hashOn host requires --ingest object"
+                )
+            begin, end = (
+                block_interval
+                if block_interval is not None
+                else (conf.numRetweetBegin, conf.numRetweetEnd)
+            )
+            source: Source = BlockReplayFileSource(
+                conf.replayFile, num_retweet_begin=begin, num_retweet_end=end
+            )
+            return _wrap_faults(source, conf)
+        source = ReplayFileSource(conf.replayFile, speed=conf.replaySpeed)
+    elif conf.source == "synthetic":
+        source = SyntheticSource(rate=conf.replaySpeed or 0.0)
+    elif conf.source == "twitter":
+        from ..streaming.twitter import TwitterSource
+
+        source = TwitterSource.from_properties()
+    else:
+        raise SystemExit(f"unknown --source {conf.source!r}")
+    return _wrap_faults(source, conf)
+
+
+def _wrap_faults(source: Source, conf) -> Source:
+    if conf.faultEvery > 0:
+        from ..streaming.faults import FaultInjectingSource
+
+        # finite replay files need the crash cap to avoid livelock (each
+        # restart re-reads from the start); unbounded sources keep crashing
+        source = FaultInjectingSource(
+            source,
+            crash_every=conf.faultEvery,
+            max_crashes=3 if conf.source == "replay" else 0,
+        )
+    return source
+
+
+def mesh_shape(conf) -> int:
+    """Data-axis size the conf + attached devices call for: the number of
+    visible devices, capped by the ``--master local[N]`` hint."""
+    import jax
+
+    shards = conf.local_shards()
+    n_devices = len(jax.devices())
+    return min(shards, n_devices) if shards else n_devices
+
+
+def build_mesh(conf, what: str = "training"):
+    """The one-flag cluster story: the ('data',) mesh the conf calls for, or
+    None when a single device (or local[1]) keeps execution unsharded. Every
+    entry point routes through here so device selection / local[N] capping
+    can never diverge between apps."""
+    n_data = mesh_shape(conf)
+    if n_data <= 1:
+        return None
+    import jax
+
+    from ..parallel import make_mesh
+
+    log.info("mesh-sharded %s: %d-way data parallel", what, n_data)
+    return make_mesh(num_data=n_data, devices=jax.devices()[:n_data])
+
+
+def build_model(conf, model_cls=StreamingLinearRegressionWithSGD):
+    """Single-device fused learner on one chip; mesh-sharded learner when the
+    backend exposes several devices (or local[N] caps a virtual CPU mesh) —
+    the CLI face of BASELINE config #5's data-parallel scale-up, for ANY
+    SGD-family learner (the class's residual/prediction knobs carry over to
+    the sharded step). Returns (model, required row multiple for batches)."""
+    mesh = build_mesh(conf, what=f"training ({model_cls.__name__})")
+    if mesh is not None:
+        from ..parallel import ParallelSGDModel
+
+        model = ParallelSGDModel.from_conf(
+            conf, mesh,
+            residual_fn=model_cls.residual_fn,
+            prediction_fn=model_cls.prediction_fn,
+            round_predictions=model_cls.round_predictions,
+        )
+        return model, model.num_data
+    return model_cls.from_conf(conf), 1
+
+
+def warmup_compile(stream, model) -> None:
+    """Pre-compile the step for the known batch shape BEFORE the stream
+    starts, so the first wall-clock micro-batch doesn't swallow the whole
+    compile-time backlog (~30 s on a cold TPU chip, during which a live
+    source keeps producing). Only possible when --batchBucket AND
+    --tokenBucket pin the full XLA program shape (read from the stream's
+    own configuration — the single source of truth). The warm batch comes
+    from the stream's OWN featurize dispatch (``featurize_empty``) so it
+    compiles exactly the program the stream will run; an all-padding batch
+    is semantically a no-op for the learner (zero-sample iterations leave
+    weights untouched)."""
+    if stream.row_bucket <= 0 or stream.token_bucket <= 0:
+        return
+    import time as _time
+
+    t0 = _time.perf_counter()
+    model.step(stream.featurize_empty())
+    log.info(
+        "pre-compiled the train step for buckets (%d, %d) in %.1fs",
+        stream.row_bucket, stream.token_bucket, _time.perf_counter() - t0,
+    )

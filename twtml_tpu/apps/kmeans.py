@@ -33,7 +33,7 @@ from ..streaming.context import StreamingContext
 from ..streaming.sources import Source
 from ..telemetry.lightning import CHART_MAX_POINTS, Lightning
 from ..utils import get_logger
-from .linear_regression import build_source, select_backend
+from .common import build_mesh, build_source, select_backend
 
 log = get_logger("apps.kmeans")
 
@@ -104,8 +104,10 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
     # wait on the resolver; a slow chart just skips frames.
     chart_q = _start_chart_worker(conf)
 
+    # mesh-sharded clustering on several devices / --master local[N]: rows
+    # shard over 'data', per-center sums psum over ICI (models/kmeans.py)
     model = (
-        StreamingKMeans()
+        StreamingKMeans(mesh=build_mesh(conf, what="clustering"))
         .set_k(NUM_CLUSTERS)
         .set_half_life(5, "batches")
         .set_random_centers(NUM_DIMENSIONS, 0.0)
@@ -113,6 +115,13 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
     scale = jax.jit(standard_scale)
     ssc = StreamingContext(batch_interval=conf.seconds)
     totals = {"count": 0, "batches": 0}
+
+    def _rows_for(n: int) -> int:
+        """Pad rows to a power-of-two bucket so XLA compiles a handful of
+        shapes, not one per batch size (same policy as features/batch.py),
+        then to a multiple of the mesh's data axis for even sharding."""
+        rows = _bucket(n)
+        return -(-rows // model.num_data) * model.num_data
 
     def on_batch(statuses: list[Status], _batch_time) -> None:
         from ..features.blocks import COL_FOLLOWERS, COL_LABEL, ParsedBlock, merge_blocks
@@ -125,7 +134,7 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
             if n == 0:
                 log.debug("batch: 0")
                 return
-            rows = _bucket(n)
+            rows = _rows_for(n)
             pts = np.zeros((rows, NUM_DIMENSIONS), np.float32)
             pts[:n, 0] = block.numeric[:, COL_LABEL]
             pts[:n, 1] = block.numeric[:, COL_FOLLOWERS]
@@ -135,9 +144,7 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
                 log.debug("batch: 0")
                 return
             n = len(retweets)
-            # pad rows to a power-of-two bucket so XLA compiles a handful of
-            # shapes, not one per batch size (same policy as features/batch.py)
-            rows = _bucket(n)
+            rows = _rows_for(n)
             pts = np.zeros((rows, NUM_DIMENSIONS), np.float32)
             pts[:n] = np.stack([featurize(s) for s in retweets])
         mask = np.zeros((rows,), np.float32)

@@ -28,7 +28,7 @@ from ..models.logistic import StreamingLogisticRegressionWithSGD
 from ..streaming.context import StreamingContext
 from ..telemetry.session_stats import SessionStats
 from ..utils import get_logger, round_half_up
-from .linear_regression import build_source, select_backend, warmup_compile
+from .common import build_model, build_source, select_backend, warmup_compile
 
 log = get_logger("apps.logistic")
 
@@ -40,12 +40,16 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     featurizer.label_fn = sentiment_label
     featurizer.batch_label_fn = sentiment_labels  # C hot path, same labels
     featurizer.unit_label_fn = sentiment_labels_from_units  # block ingest
-    model = StreamingLogisticRegressionWithSGD.from_conf(conf)
+    # mesh-sharded automatically on several devices / --master local[N],
+    # exactly like the flagship app (the logistic residual rides the same
+    # sharded step)
+    model, row_multiple = build_model(conf, StreamingLogisticRegressionWithSGD)
 
     ssc = StreamingContext(batch_interval=conf.seconds)
     stream = ssc.source_stream(
         build_source(conf, allow_block=True), featurizer,
         row_bucket=conf.batchBucket, token_bucket=conf.tokenBucket,
+        row_multiple=row_multiple,
         device_hash=conf.hashOn == "device",
     )
     totals = {"count": 0, "batches": 0}

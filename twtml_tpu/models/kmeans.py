@@ -17,6 +17,12 @@ smallest is replaced.
 
 Assignment uses a [B,k] distance matrix and a one-hot matmul for the per-center
 sums — k is small, B is the batch, both land on the MXU.
+
+Data-parallel on a device mesh (``mesh=`` arg): batch rows are sharded over
+the ``data`` axis and the per-center sums/counts/num_points become ``psum``s
+over ICI — the same treeAggregate→psum translation as the SGD models
+(parallel/sharding.py); centers/weights stay replicated, so the decay and
+dying-cluster arithmetic is computed identically on every shard.
 """
 
 from __future__ import annotations
@@ -26,6 +32,8 @@ import math
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
 
 BATCHES = "batches"
 POINTS = "points"
@@ -41,9 +49,12 @@ def _sq_dists(points, centers):
     )
 
 
-def _update_step(centers, weights, points, mask, decay_factor, time_unit):
+def _update_step(centers, weights, points, mask, decay_factor, time_unit,
+                 axis_name=None):
     """One streaming k-means batch update. centers [k,D], weights [k],
-    points [B,D], mask [B]."""
+    points [B,D], mask [B]. Under shard_map, ``axis_name`` globalizes the
+    batch reductions with psum; everything downstream of them is replicated
+    arithmetic."""
     k = centers.shape[0]
     assign = jnp.argmin(_sq_dists(points, centers), axis=1)  # [B]
     onehot = jax.nn.one_hot(assign, k, dtype=points.dtype) * mask[:, None]  # [B,k]
@@ -51,6 +62,10 @@ def _update_step(centers, weights, points, mask, decay_factor, time_unit):
     sums = onehot.T @ points  # [k, D]
 
     num_points = jnp.sum(mask)
+    if axis_name:
+        counts = lax.psum(counts, axis_name)
+        sums = lax.psum(sums, axis_name)
+        num_points = lax.psum(num_points, axis_name)
     if time_unit == BATCHES:
         discount = jnp.asarray(decay_factor, points.dtype)
     else:
@@ -82,10 +97,18 @@ def _update_step(centers, weights, points, mask, decay_factor, time_unit):
 
 
 class StreamingKMeans:
-    def __init__(self, k: int = 2, decay_factor: float = 1.0, time_unit: str = BATCHES):
+    def __init__(
+        self,
+        k: int = 2,
+        decay_factor: float = 1.0,
+        time_unit: str = BATCHES,
+        mesh=None,
+    ):
         self.k = k
         self.decay_factor = decay_factor
         self.time_unit = time_unit
+        self.mesh = mesh
+        self.num_data = 1 if mesh is None else mesh.shape[mesh.axis_names[0]]
         self.centers: jnp.ndarray | None = None
         self.cluster_weights: jnp.ndarray | None = None
         self._step = None
@@ -97,9 +120,23 @@ class StreamingKMeans:
         if self._step is None or self._step_config != cfg:
             from functools import partial
 
-            self._step = jax.jit(
-                partial(_update_step, decay_factor=cfg[0], time_unit=cfg[1])
-            )
+            if self.mesh is None:
+                self._step = jax.jit(
+                    partial(_update_step, decay_factor=cfg[0], time_unit=cfg[1])
+                )
+            else:
+                data_axis = self.mesh.axis_names[0]
+                body = partial(
+                    _update_step,
+                    decay_factor=cfg[0], time_unit=cfg[1], axis_name=data_axis,
+                )
+                self._step = jax.jit(jax.shard_map(
+                    body,
+                    mesh=self.mesh,
+                    # centers/weights replicated; rows sharded over 'data'
+                    in_specs=(P(), P(), P(data_axis, None), P(data_axis)),
+                    out_specs=(P(), P(), P(data_axis)),
+                ))
             self._step_config = cfg
         return self._step
 
@@ -141,6 +178,11 @@ class StreamingKMeans:
             mask = jnp.asarray(mask, dtype=jnp.float32)
         if self.centers is None:
             raise ValueError("call set_random_centers or set_initial_centers first")
+        if points.shape[0] % self.num_data:
+            raise ValueError(
+                f"batch rows {points.shape[0]} not divisible by data shards "
+                f"{self.num_data}; pad rows to a multiple of the mesh's data axis"
+            )
         self.centers, self.cluster_weights, assign = self._get_step()(
             self.centers, self.cluster_weights, points, mask
         )

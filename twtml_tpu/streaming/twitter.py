@@ -62,6 +62,12 @@ class TwitterSource(Source):
         url: str = STREAM_URL,
         **kw,
     ):
+        # a live receiver retries indefinitely (Twitter4j semantics): the
+        # backoff ladder, not a restart cap, is the pressure valve — the
+        # generic max_restarts=3 would kill the stream on a 2s network blip
+        # (three consecutive failed connects emit nothing, so the
+        # healthy-production reset never fires)
+        kw.setdefault("max_restarts", 1_000_000)
         super().__init__(**kw)
         self.credentials = credentials
         self.url = url
