@@ -63,6 +63,45 @@ def main() -> None:
             sts, row_bucket=len(sts), token_bucket=64, pre_filtered=True
         )
 
+    if mesh_kind == "2d_ckpt":
+        # checkpoint round-trip on the cross-process feature-sharded layout:
+        # step → gather (process_allgather: shards are NOT fully addressable
+        # here) → pid 0 writes the .npz → barrier → BOTH processes restore
+        # into a FRESH model (set_initial_weights materializes only local
+        # shards via make_array_from_callback) → second step. Must equal an
+        # uninterrupted 2-step run.
+        from jax.experimental import multihost_utils
+
+        from twtml_tpu.checkpoint import Checkpointer
+
+        d = jax.devices()
+        mesh = make_mesh(
+            num_data=2, num_model=2, devices=[d[0], d[2], d[1], d[3]]
+        )
+        model = ParallelSGDModel(
+            mesh, num_text_features=1000, num_iterations=5, step_size=0.005
+        )
+        global_batch = shard_batch(featurize(statuses), mesh)
+        model.step(global_batch)
+        ckpt = Checkpointer(os.environ["TWTML_CKPT_DIR"])
+        gathered = model.latest_weights  # collective: every process calls it
+        if pid == 0:
+            ckpt.save(1, gathered, {"batches": 1})
+        multihost_utils.sync_global_devices("ckpt-written")
+        weights, meta = ckpt.restore()
+        assert meta["batches"] == 1
+        resumed = ParallelSGDModel(
+            mesh, num_text_features=1000, num_iterations=5, step_size=0.005
+        ).set_initial_weights(weights)
+        assert not resumed._weights["text"].is_fully_addressable
+        out = resumed.step(global_batch)
+        print(json.dumps({
+            "process": pid,
+            "count": float(out.count),
+            "mse": float(out.mse),
+            "weights": np.asarray(resumed.latest_weights).tolist(),
+        }), flush=True)
+        return
     if mesh_kind == "2d":
         # arrange devices so the MODEL axis pairs devices from DIFFERENT
         # processes: jax.devices() is process-major [p0d0,p0d1,p1d0,p1d1];

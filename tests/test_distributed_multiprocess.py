@@ -33,10 +33,11 @@ def _free_port() -> int:
 
 
 def _run_group(
-    wire: str, nprocs: int = 2, timeout: float = 180.0, mesh: str = "1d"
+    wire: str, nprocs: int = 2, timeout: float = 180.0, mesh: str = "1d",
+    extra_env: dict | None = None,
 ):
     port = _free_port()
-    env = dict(os.environ, PYTHONPATH=REPO)
+    env = dict(os.environ, PYTHONPATH=REPO, **(extra_env or {}))
     procs = [
         subprocess.Popen(
             [sys.executable, WORKER, str(i), str(nprocs), str(port), wire, mesh],
@@ -103,6 +104,46 @@ def test_two_process_group_trains_in_lockstep(wire):
     assert outs[0]["mse"] == pytest.approx(mse, rel=1e-4)
     np.testing.assert_allclose(
         outs[0]["weights"], weights, rtol=1e-4, atol=1e-7
+    )
+
+
+def test_two_process_2d_mesh_checkpoint_roundtrip(tmp_path):
+    """Checkpoint round-trip where weight shards span PROCESS boundaries:
+    latest_weights process_allgathers, pid 0 writes, both restore into fresh
+    models whose text shards are not fully addressable, training continues —
+    equal to an uninterrupted 2-step single-process run."""
+    outs = _run_group(
+        "unit", mesh="2d_ckpt", extra_env={"TWTML_CKPT_DIR": str(tmp_path)}
+    )
+    assert outs[0]["count"] == outs[1]["count"] == 64.0
+    np.testing.assert_allclose(outs[0]["weights"], outs[1]["weights"], rtol=1e-6)
+
+    # single-process ground truth: the same two steps, no interruption
+    from twtml_tpu.models import StreamingLinearRegressionWithSGD
+
+    from twtml_tpu.features.batch import UnitBatch
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.streaming.sources import SyntheticSource
+
+    statuses = list(
+        SyntheticSource(total=64, seed=7, base_ms=1785320000000).produce()
+    )
+    feat = Featurizer(now_ms=1785320000000)
+    shards = [
+        feat.featurize_batch_units(
+            statuses[pid::2], row_bucket=16, unit_bucket=64, pre_filtered=True
+        )
+        for pid in range(2)
+    ]
+    global_batch = UnitBatch(*(
+        np.concatenate([getattr(s, f) for s in shards], axis=0)
+        for f in UnitBatch._fields
+    ))
+    model = StreamingLinearRegressionWithSGD(num_iterations=5, step_size=0.005)
+    model.step(global_batch)
+    model.step(global_batch)
+    np.testing.assert_allclose(
+        outs[0]["weights"], model.latest_weights, rtol=1e-4, atol=1e-7
     )
 
 

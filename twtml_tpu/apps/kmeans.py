@@ -25,7 +25,7 @@ import jax
 import numpy as np
 
 from ..config import ConfArguments
-from ..features.batch import _bucket
+from ..features.batch import pad_row_count
 from ..features.featurizer import Status
 from ..models.kmeans import StreamingKMeans
 from ..ops.scaler import standard_scale
@@ -117,11 +117,9 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
     totals = {"count": 0, "batches": 0}
 
     def _rows_for(n: int) -> int:
-        """Pad rows to a power-of-two bucket so XLA compiles a handful of
-        shapes, not one per batch size (same policy as features/batch.py),
-        then to a multiple of the mesh's data axis for even sharding."""
-        rows = _bucket(n)
-        return -(-rows // model.num_data) * model.num_data
+        """The central padding policy (features/batch.py): power-of-two
+        bucket, rounded to the mesh's data-axis multiple."""
+        return pad_row_count(n, 0, model.num_data)
 
     def on_batch(statuses: list[Status], _batch_time) -> None:
         from ..features.blocks import COL_FOLLOWERS, COL_LABEL, ParsedBlock, merge_blocks

@@ -316,10 +316,15 @@ class ParallelSGDModel:
         weights = np.asarray(weights, dtype=self.dtype)
         if isinstance(self._weights, dict):
             ft = self.num_text_features
+            text = weights[:ft]
+            sharding = NamedSharding(self.mesh, P(self.model_axis))
+            # make_array_from_callback, not device_put: checkpoint restore
+            # must also work when the model axis spans processes and this
+            # process does not address every shard (the allgather mirror of
+            # _to_host) — each process materializes only its local slices
             self._weights = {
-                "text": jax.device_put(
-                    jnp.asarray(weights[:ft]),
-                    NamedSharding(self.mesh, P(self.model_axis)),
+                "text": jax.make_array_from_callback(
+                    text.shape, sharding, lambda idx: text[idx]
                 ),
                 "num": jnp.asarray(weights[ft:]),
             }
