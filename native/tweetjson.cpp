@@ -117,7 +117,13 @@ bool scan_string(Cursor& cur, uint16_t* out, int64_t cap, int64_t* n_units) {
       }
       continue;
     }
-    // UTF-8 decode (1-4 bytes) -> code point
+    // UTF-8 decode (1-4 bytes) -> code point, matching what the Python
+    // fallback's json.loads(bytes) accepts: overlong encodings and values
+    // past U+10FFFF are malformed (CPython utf-8 is strict about those),
+    // but UTF-8-encoded SURROGATE code points are kept as lone UTF-16
+    // units — json decodes bytes with errors='surrogatepass', and the
+    // hashing ground truth handles lone surrogates by design
+    // (features/hashing.py utf16_units)
     uint32_t cp;
     int extra;
     if (c < 0x80) { cp = c; extra = 0; }
@@ -131,6 +137,9 @@ bool scan_string(Cursor& cur, uint16_t* out, int64_t cap, int64_t* n_units) {
       if ((cc >> 6) != 0x2) return false;
       cp = (cp << 6) | (cc & 0x3F);
     }
+    if (extra == 1 && cp < 0x80) return false;          // overlong
+    if (extra == 2 && cp < 0x800) return false;         // overlong
+    if (extra == 3 && (cp < 0x10000 || cp > 0x10FFFF)) return false;
     cur.p += extra + 1;
     emit(cp);
   }
@@ -256,7 +265,14 @@ struct RtFields {
   bool present = false;
 };
 
-constexpr int64_t kMaxTextUnits = 4096;  // tweets cap well below this
+// Documented bound of the columnar wire format: a retweeted status whose
+// "text"/"full_text" exceeds this many UTF-16 units makes the LINE a counted
+// bad line (cur.ok = false on buffer overflow below). Real tweets cap well
+// below this; the Python block fallback (_py_parse) pins the identical drop,
+// and the object-ingest Status path (the semantic ground truth) has no such
+// bound — a flagged, tested divergence on adversarial input only
+// (tests/test_block_ingest.py::test_oversized_text_drops_line_both_paths).
+constexpr int64_t kMaxTextUnits = 4096;
 
 // Parse the retweeted_status object, extracting our fields. ``text_buf``
 // and ``full_buf`` each hold kMaxTextUnits; the caller picks text-or-

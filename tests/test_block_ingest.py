@@ -232,6 +232,111 @@ def test_py_fallback_skips_non_object_json(feat, tmp_path, monkeypatch):
     assert blk.num_valid == 2
 
 
+GOOD_LINE = {"text": "RT", "retweeted_status": {"text": "ok", "retweet_count": 500,
+             "user": {"followers_count": 1, "favourites_count": 1,
+                      "friends_count": 1}, "timestamp_ms": "1785313333333"}}
+
+
+def _both_paths(path, feat, monkeypatch):
+    """(C-path batch, Python-fallback batch) over the same file."""
+    from twtml_tpu.features import native
+
+    c = _block_path_batch(str(path), feat, row_bucket=8, unit_bucket=8192)
+    with monkeypatch.context() as m:
+        m.setattr(native, "parse_tweet_block", lambda *a, **k: None)
+        py = _block_path_batch(str(path), feat, row_bucket=8, unit_bucket=8192)
+    return c, py
+
+
+def test_oversized_text_drops_line_both_paths(feat, tmp_path, monkeypatch):
+    """ADVICE r1: a retweeted status whose text exceeds the wire-format
+    bound (4096 UTF-16 units) is a counted bad line in the C parser AND the
+    Python fallback — pinned, documented divergence from object ingest."""
+    from twtml_tpu.features.native import MAX_TEXT_UNITS
+
+    over = {"text": "RT", "retweeted_status": {
+        "text": "a" * (MAX_TEXT_UNITS + 1), "retweet_count": 500,
+        "user": {"followers_count": 1, "favourites_count": 1,
+                 "friends_count": 1}}}
+    # oversized full_text drops even when a small text would win
+    over_full = {"text": "RT", "retweeted_status": {
+        "text": "tiny", "full_text": "b" * (MAX_TEXT_UNITS + 100),
+        "retweet_count": 500, "user": {"followers_count": 1,
+        "favourites_count": 1, "friends_count": 1}}}
+    at_bound = {"text": "RT", "retweeted_status": {
+        "text": "c" * MAX_TEXT_UNITS, "retweet_count": 500,
+        "user": {"followers_count": 1, "favourites_count": 1,
+                 "friends_count": 1}, "timestamp_ms": "1785313333333"}}
+    path = tmp_path / "oversized.jsonl"
+    # duplicate "text" keys: the C scanner caps EVERY occurrence, so an
+    # oversized first text drops the line even though dict-wise the small
+    # last duplicate wins — the fallback pins the same any-occurrence rule
+    dup_text = (
+        '{"text": "RT", "retweeted_status": {"text": "'
+        + "d" * 4097
+        + '", "text": "small wins", "retweet_count": 500, '
+        '"user": {"followers_count": 1}}}'
+    )
+    path.write_text(
+        "\n".join([json.dumps(o) for o in
+                   (GOOD_LINE, over, over_full, at_bound)]
+                  + [dup_text, json.dumps(GOOD_LINE)]) + "\n",
+        encoding="utf-8",
+    )
+    c, py = _both_paths(path, feat, monkeypatch)
+    # kept: good, at-bound (exactly 4096 units), good — dropped: the two over
+    assert c.num_valid == py.num_valid == 3
+    _assert_batches_equal(c, py)
+    assert int(max(c.length)) == 4096  # the at-bound row kept in full
+
+
+def test_invalid_utf8_drops_line_both_paths(feat, tmp_path, monkeypatch):
+    """ADVICE r1: overlong UTF-8 encodings are malformed in Python's utf-8
+    codec (which json.loads(bytes) rides), so the C parser must reject them
+    too — but UTF-8-encoded SURROGATES are KEPT by json.loads (it decodes
+    bytes with errors='surrogatepass'), so both block paths keep those rows
+    as lone UTF-16 units, matching the JVM view (features/hashing.py)."""
+    good = json.dumps(GOOD_LINE).encode("utf-8")
+    # overlong '/' (0xC0 0xAF) inside the rt text
+    overlong = (b'{"text": "RT", "retweeted_status": {"text": "x\xc0\xafy", '
+                b'"retweet_count": 500, "user": {"followers_count": 1}}}')
+    # overlong NUL (0xC0 0x80) — the classic modified-UTF-8 case
+    overlong_nul = (b'{"text": "RT", "retweeted_status": {"text": "x\xc0\x80y", '
+                    b'"retweet_count": 500, "user": {"followers_count": 1}}}')
+    # out-of-range code point U+110000 (0xF4 0x90 0x80 0x80)
+    too_big = (b'{"text": "RT", "retweeted_status": {"text": "x\xf4\x90\x80\x80y", '
+               b'"retweet_count": 500, "user": {"followers_count": 1}}}')
+    # raw UTF-8-encoded surrogate U+D800 (0xED 0xA0 0x80): KEPT, like json
+    surrogate = (b'{"text": "RT", "retweeted_status": {"text": "x\xed\xa0\x80y", '
+                 b'"retweet_count": 500, "user": {"followers_count": 1}}}')
+    # escaped lone surrogate: valid JSON, kept, exercises the
+    # surrogatepass encode in the fallback's encode_texts
+    escaped = (b'{"text": "RT", "retweeted_status": {"text": "x\\ud800y", '
+               b'"retweet_count": 500, "user": {"followers_count": 1}}}')
+    path = tmp_path / "badutf8.jsonl"
+    path.write_bytes(
+        good + b"\n" + overlong + b"\n" + surrogate + b"\n" + escaped + b"\n"
+        + overlong_nul + b"\n" + too_big + b"\n" + good + b"\n"
+    )
+    c, py = _both_paths(path, feat, monkeypatch)
+    # kept: good, raw-surrogate, escaped-surrogate, good
+    assert c.num_valid == py.num_valid == 4
+    _assert_batches_equal(c, py)
+    # both surrogate rows carry the lone 0xD800 unit, not a replacement char
+    assert (np.asarray(c.units) == 0xD800).sum() == 2
+
+
+def test_merge_blocks_empty_returns_zero_row_block():
+    """ADVICE r1: merge_blocks([]) must not crash (a replay file where no
+    line passes the filter)."""
+    from twtml_tpu.features.blocks import ParsedBlock
+
+    block = merge_blocks([])
+    assert isinstance(block, ParsedBlock)
+    assert block.rows == 0
+    assert block.offsets.tolist() == [0]
+
+
 def test_block_ingest_rejected_outside_linear_app(tmp_path):
     from twtml_tpu.apps.linear_regression import build_source
     from twtml_tpu.config import ConfArguments

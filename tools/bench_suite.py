@@ -146,8 +146,15 @@ def run_config(name: str, n_tweets: int, batch_size: int) -> dict:
         try:
             src = BlockReplayFileSource(path)
             blocks = list(src.produce())
-            block = merge_blocks(blocks)
+            block = merge_blocks(blocks)  # [] merges to a zero-row block
             rows = block.rows
+            if rows == 0:
+                return {
+                    **out, "tweets_per_sec": 0.0, "seconds": 0.0,
+                    "batches": 0, "final_metric": 0.0,
+                    "backend": jax.default_backend(),
+                    "note": "replay file produced zero kept rows",
+                }
             # row ranges double as measure_pipeline's "chunks" (len() = rows)
             starts = [
                 range(i, min(i + batch_size, rows))

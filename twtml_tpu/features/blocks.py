@@ -43,8 +43,21 @@ class ParsedBlock(NamedTuple):
         return int(self.numeric.shape[0])
 
 
+def empty_block() -> ParsedBlock:
+    """A zero-row block (a replay file where no line passed the filter)."""
+    return ParsedBlock(
+        np.zeros((0, 5), np.int64),
+        np.zeros((0,), np.uint16),
+        np.zeros((1,), np.int64),
+        np.zeros((0,), np.uint8),
+    )
+
+
 def merge_blocks(blocks: "list[ParsedBlock]") -> ParsedBlock:
-    """Concatenate blocks drained from one micro-batch interval."""
+    """Concatenate blocks drained from one micro-batch interval; an empty
+    list merges to a zero-row block."""
+    if not blocks:
+        return empty_block()
     if len(blocks) == 1:
         return blocks[0]
     numeric = np.concatenate([b.numeric for b in blocks], axis=0)

@@ -27,6 +27,11 @@ _SRCS = [
 ]
 _LIB = os.path.join(_REPO_ROOT, "native", "libfasthash.so")
 
+# the C data-loader's per-row text bound (kMaxTextUnits, native/tweetjson.cpp):
+# a retweeted status whose text/full_text exceeds this many UTF-16 units makes
+# the line a counted bad line in BOTH block paths (C and Python fallback)
+MAX_TEXT_UNITS = 4096
+
 
 def _sources_ok() -> bool:
     return all(os.path.exists(s) for s in _SRCS)
@@ -176,13 +181,19 @@ def encode_texts(texts: list[str]) -> tuple[np.ndarray, np.ndarray]:
     split the joined buffer: len(t) when every char is BMP (1 unit each),
     with a per-text re-encode only in the rare astral-emoji case."""
     joined = "".join(texts)
-    units = np.frombuffer(joined.encode("utf-16-le"), dtype=np.uint16)
+    # surrogatepass: json.loads produces lone surrogates (escaped \uD800 or
+    # raw surrogate UTF-8 bytes, which it decodes permissively); the JVM
+    # ground truth treats them as ordinary units (features/hashing.py)
+    units = np.frombuffer(
+        joined.encode("utf-16-le", "surrogatepass"), dtype=np.uint16
+    )
     offsets = np.zeros(len(texts) + 1, dtype=np.int64)
     if units.size == len(joined):  # no astral chars: 1 unit per char
         counts = [len(t) for t in texts]
     else:
         counts = [
-            len(t) if t.isascii() else len(t.encode("utf-16-le")) >> 1
+            len(t) if t.isascii()
+            else len(t.encode("utf-16-le", "surrogatepass")) >> 1
             for t in texts
         ]
     np.cumsum(counts, out=offsets[1:])
@@ -281,9 +292,9 @@ def parse_tweet_block(
     if cap_rows <= 0:
         cap_rows = max(16, data.count(b"\n") + 1)
     # total text units from n input bytes is < n; the parser additionally
-    # reserves one full row (kMaxTextUnits = 4096) of headroom before each
-    # line, so size past that to never trip the early-stop mid-block
-    cap_units = n + 4096 + 1
+    # reserves one full row (kMaxTextUnits) of headroom before each line,
+    # so size past that to never trip the early-stop mid-block
+    cap_units = n + MAX_TEXT_UNITS + 1
     numeric = np.empty((cap_rows, 5), dtype=np.int64)
     units = np.empty((cap_units,), dtype=np.uint16)
     offsets = np.empty((cap_rows + 1,), dtype=np.int64)

@@ -220,7 +220,35 @@ class BlockReplayFileSource(Source):
         import numpy as np
 
         from ..features.blocks import ParsedBlock
-        from ..features.native import encode_texts
+        from ..features.native import MAX_TEXT_UNITS, encode_texts
+
+        # the C parser's documented wire-format bound (kMaxTextUnits,
+        # native/tweetjson.cpp): a retweeted status with ANY "text"/
+        # "full_text" occurrence (duplicate JSON keys included — the C
+        # scanner caps every occurrence, while plain dicts keep only the
+        # last) over the unit bound makes the whole line a counted bad
+        # line — pinned here so both block paths agree on adversarial
+        # input (the object-ingest Status path keeps such rows)
+        class _Obj(dict):
+            oversized = False
+
+        def _pairs_hook(pairs):
+            d = _Obj(pairs)
+            for k, v in pairs:
+                if (
+                    k in ("text", "full_text")
+                    and isinstance(v, str)
+                    and len(v.encode("utf-16-le", "surrogatepass")) // 2
+                    > MAX_TEXT_UNITS
+                ):
+                    d.oversized = True
+            return d
+
+        def oversized(obj) -> bool:
+            rt = obj.get("retweeted_status") if isinstance(obj, dict) else None
+            # only the retweeted_status object's DIRECT text fields are
+            # bounded (the C parser skips all other strings uncapped)
+            return getattr(rt, "oversized", False)
 
         nl = data.rfind(b"\n")
         if nl < 0:
@@ -232,7 +260,10 @@ class BlockReplayFileSource(Source):
             if not ln:
                 continue
             try:
-                status = Status.from_json(json.loads(ln))
+                obj = json.loads(ln, object_pairs_hook=_pairs_hook)
+                if oversized(obj):
+                    raise ValueError("text exceeds the wire-format unit bound")
+                status = Status.from_json(obj)
             except (ValueError, AttributeError, TypeError):
                 # same contract as the C parser: malformed lines (including
                 # valid JSON that isn't a tweet object) skip, never crash
