@@ -75,6 +75,45 @@ bool scan_string(Cursor& cur, uint16_t* out, int64_t cap, int64_t* n_units) {
     }
   };
   while (!cur.at_end() && cur.ok) {
+    // bulk fast path: plain-ASCII runs (the overwhelming majority of tweet
+    // bytes) copy/count without per-byte dispatch — SWAR scans 8 bytes per
+    // iteration for the next special byte (quote/escape/UTF-8 lead); the
+    // scalar loop below handles only that byte
+    {
+      const char* q = cur.p;
+      while (cur.end - q >= 8) {
+        uint64_t v;
+        std::memcpy(&v, q, 8);
+        uint64_t hi = v & 0x8080808080808080ULL;           // >= 0x80
+        uint64_t xq = v ^ 0x2222222222222222ULL;           // '"'
+        uint64_t xb = v ^ 0x5C5C5C5C5C5C5C5CULL;           // '\\'
+        uint64_t sq = (xq - 0x0101010101010101ULL) & ~xq;
+        uint64_t sb = (xb - 0x0101010101010101ULL) & ~xb;
+        uint64_t special = (hi | sq | sb) & 0x8080808080808080ULL;
+        if (special) {
+          q += __builtin_ctzll(special) >> 3;
+          break;
+        }
+        q += 8;
+      }
+      while (q < cur.end) {
+        unsigned char cc = static_cast<unsigned char>(*q);
+        if (cc == '"' || cc == '\\' || cc >= 0x80) break;
+        ++q;
+      }
+      int64_t run = q - cur.p;
+      if (run > 0) {
+        if (out != nullptr) {
+          if (n + run > cap) { cur.ok = false; return false; }
+          for (int64_t i = 0; i < run; ++i)
+            out[n + i] = static_cast<uint16_t>(
+                static_cast<unsigned char>(cur.p[i]));
+        }
+        n += run;
+        cur.p = q;
+        if (cur.at_end()) break;
+      }
+    }
     unsigned char c = static_cast<unsigned char>(*cur.p);
     if (c == '"') {
       ++cur.p;

@@ -277,10 +277,18 @@ def test_oversized_text_drops_line_both_paths(feat, tmp_path, monkeypatch):
         + '", "text": "small wins", "retweet_count": 500, '
         '"user": {"followers_count": 1}}}'
     )
+    # duplicate retweeted_status keys: the C parser scans (and caps) the
+    # FIRST occurrence too, while dict-wise only the clean last one survives
+    dup_rt = (
+        '{"text": "RT", "retweeted_status": {"text": "'
+        + "e" * 4097
+        + '", "retweet_count": 500}, "retweeted_status": {"text": "clean", '
+        '"retweet_count": 500, "user": {"followers_count": 1}}}'
+    )
     path.write_text(
         "\n".join([json.dumps(o) for o in
                    (GOOD_LINE, over, over_full, at_bound)]
-                  + [dup_text, json.dumps(GOOD_LINE)]) + "\n",
+                  + [dup_text, dup_rt, json.dumps(GOOD_LINE)]) + "\n",
         encoding="utf-8",
     )
     c, py = _both_paths(path, feat, monkeypatch)
@@ -324,6 +332,32 @@ def test_invalid_utf8_drops_line_both_paths(feat, tmp_path, monkeypatch):
     _assert_batches_equal(c, py)
     # both surrogate rows carry the lone 0xD800 unit, not a replacement char
     assert (np.asarray(c.units) == 0xD800).sum() == 2
+
+
+def test_iter_row_chunks_preserves_rows(feat):
+    """The micro-batch slicer (blocks.py iter_row_chunks) must regroup
+    arbitrary block boundaries into exact row chunks with identical data."""
+    from twtml_tpu.features.blocks import iter_row_chunks, slice_block
+
+    src = BlockReplayFileSource(DATA, block_bytes=256)  # many tiny blocks
+    blocks = list(src.produce())
+    whole = merge_blocks(blocks)
+    for rows in (1, 2, 3, whole.rows, whole.rows + 5):
+        chunks = list(iter_row_chunks(iter(blocks), rows))
+        assert [c.rows for c in chunks[:-1]] == [rows] * (len(chunks) - 1)
+        assert sum(c.rows for c in chunks) == whole.rows
+        re = merge_blocks(chunks)
+        np.testing.assert_array_equal(re.numeric, whole.numeric)
+        np.testing.assert_array_equal(re.units, whole.units)
+        np.testing.assert_array_equal(re.offsets, whole.offsets)
+        np.testing.assert_array_equal(re.ascii, whole.ascii)
+    # slice_block round-trip
+    mid = slice_block(whole, 2, 5)
+    assert mid.rows == 3
+    np.testing.assert_array_equal(mid.numeric, whole.numeric[2:5])
+    np.testing.assert_array_equal(
+        mid.units, whole.units[whole.offsets[2] : whole.offsets[5]]
+    )
 
 
 def test_merge_blocks_empty_returns_zero_row_block():

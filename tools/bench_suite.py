@@ -127,13 +127,19 @@ def run_config(name: str, n_tweets: int, batch_size: int) -> dict:
     if name == "replay_linear":
         # the BASELINE config is a replayed-tweet FILE source: materialize
         # the synthetic stream to .jsonl once, then measure the real ingest
-        # path end-to-end — native block parse → featurize → fused step
+        # path end-to-end — native block parse → featurize → fused step.
+        # The three stages run PIPELINED per pass (VERDICT r1 #4): a worker
+        # thread owns the C parser (ctypes releases the GIL), a prefetch
+        # thread featurizes the next chunk, and the main thread keeps every
+        # device interaction (device_put off-main collapses the transport).
+        import queue
         import tempfile
+        import threading
 
-        from twtml_tpu.features.blocks import merge_blocks
+        from twtml_tpu.features.blocks import iter_row_chunks, merge_blocks
         from twtml_tpu.models import StreamingLinearRegressionWithSGD
         from twtml_tpu.streaming.sources import BlockReplayFileSource
-        from twtml_tpu.utils.benchloop import measure_pipeline
+        from twtml_tpu.utils.benchloop import measure_passes
 
         feat = Featurizer(now_ms=1785320000000)
         model = StreamingLinearRegressionWithSGD()
@@ -144,9 +150,7 @@ def run_config(name: str, n_tweets: int, batch_size: int) -> dict:
                 fh.write(json.dumps(_status_json(s)) + "\n")
             path = fh.name
         try:
-            src = BlockReplayFileSource(path)
-            blocks = list(src.produce())
-            block = merge_blocks(blocks)  # [] merges to a zero-row block
+            block = merge_blocks(list(BlockReplayFileSource(path).produce()))
             rows = block.rows
             if rows == 0:
                 return {
@@ -155,37 +159,88 @@ def run_config(name: str, n_tweets: int, batch_size: int) -> dict:
                     "backend": jax.default_backend(),
                     "note": "replay file produced zero kept rows",
                 }
-            # row ranges double as measure_pipeline's "chunks" (len() = rows)
-            starts = [
-                range(i, min(i + batch_size, rows))
-                for i in range(0, rows, batch_size)
-            ]
+            n_chunks = -(-rows // batch_size)
 
-            def featurize(r):
-                sub = type(block)(
-                    block.numeric[r.start : r.stop],
-                    block.units[block.offsets[r.start] : block.offsets[r.stop]],
-                    block.offsets[r.start : r.stop + 1] - block.offsets[r.start],
-                    block.ascii[r.start : r.stop],
-                )
+            def featurize(sub):
                 return feat.featurize_parsed_block(sub, row_bucket=batch_size)
 
-            # file parse and the sustained featurize+train loop are measured
-            # separately (the loop re-featurizes each pass); the headline is
-            # their combination — one file read through to trained weights
-            t0 = time.perf_counter()
-            list(BlockReplayFileSource(path).produce())
-            parse_s = time.perf_counter() - t0
-            res = measure_pipeline(model, featurize, starts, repeats=3)
-            e2e_s = parse_s + res["seconds"]
+            # warm the compile caches for both the full and the tail chunk
+            for sub in iter_row_chunks([block], batch_size):
+                model.step(featurize(sub)).mse.block_until_ready()
+
+            def pipeline_source():
+                # copy=False: blocks are views, featurized promptly; 4MB
+                # blocks amortize per-call overhead (measured best on this
+                # host with the view path)
+                return BlockReplayFileSource(
+                    path, copy=False, block_bytes=4 << 20
+                ).produce()
+
+            def one_pass():
+                """File bytes → trained weights, stages overlapped: the
+                worker owns parse→chunk→featurize (its GIL-held numpy work
+                hides under the GIL-free C parse and the main thread's
+                device waits); main owns every device interaction. Worker
+                failures propagate — a truncated pass must never be scored
+                as a fast successful one."""
+                model.reset()
+                q: "queue.Queue" = queue.Queue(maxsize=8)
+
+                def producer():
+                    try:
+                        for sub in iter_row_chunks(pipeline_source(), batch_size):
+                            q.put(featurize(sub))
+                        q.put(None)
+                    except BaseException as exc:  # noqa: BLE001
+                        q.put(exc)
+
+                t0 = time.perf_counter()
+                threading.Thread(target=producer, daemon=True).start()
+                last = None
+                while True:
+                    item = q.get()
+                    if item is None:
+                        break
+                    if isinstance(item, BaseException):
+                        raise item
+                    last = model.step(item)
+                    last.mse.block_until_ready()
+                return time.perf_counter() - t0, last
+
+            # the shared stall-riding measurement core (benchloop): best-of
+            # with a time budget + settle check, never trusting one pass
+            best_dt, final, _passes = measure_passes(
+                one_pass, repeats=3, time_budget_s=30.0, settled_after=2
+            )
+
+            # stage rates for the notes column, measured with the SAME
+            # source settings the pipeline uses: parse alone, train alone
+            def parse_pass():
+                t0 = time.perf_counter()
+                for _ in pipeline_source():
+                    pass
+                return time.perf_counter() - t0, None
+
+            parse_s, _, _ = measure_passes(parse_pass, repeats=3)
+            subs = list(iter_row_chunks([block], batch_size))
+
+            def train_pass():
+                model.reset()
+                t0 = time.perf_counter()
+                for sub in subs:
+                    model.step(featurize(sub)).mse.block_until_ready()
+                return time.perf_counter() - t0, None
+
+            train_s, _, _ = measure_passes(train_pass, repeats=3)
+
             out.update(
                 {
-                    "tweets_per_sec": round(rows / e2e_s, 1),
-                    "seconds": round(e2e_s, 3),
-                    "batches": len(starts),
-                    "final_metric": round(res["final_mse"], 3),
+                    "tweets_per_sec": round(rows / best_dt, 1),
+                    "seconds": round(best_dt, 3),
+                    "batches": n_chunks,
+                    "final_metric": round(float(final.mse), 3),
                     "parse_tweets_per_sec": round(rows / parse_s, 1),
-                    "train_tweets_per_sec": round(res["tweets_per_sec"], 1),
+                    "train_tweets_per_sec": round(rows / train_s, 1),
                 }
             )
         finally:

@@ -43,6 +43,46 @@ class ParsedBlock(NamedTuple):
         return int(self.numeric.shape[0])
 
 
+def slice_block(block: ParsedBlock, start: int, stop: int) -> ParsedBlock:
+    """Rows [start, stop) as a standalone block (offsets re-based)."""
+    return ParsedBlock(
+        block.numeric[start:stop],
+        block.units[block.offsets[start] : block.offsets[stop]],
+        block.offsets[start : stop + 1] - block.offsets[start],
+        block.ascii[start:stop],
+    )
+
+
+def iter_row_chunks(blocks, rows: int):
+    """Regroup a stream of ParsedBlocks into blocks of exactly ``rows`` rows
+    (the final chunk may be short) — the micro-batch slicer between the
+    native parser's IO-sized blocks and the learner's fixed batch shape.
+    Consumes ``blocks`` lazily, so it composes with a parser running on
+    another thread (the parse/featurize/train pipeline)."""
+    pending: list[ParsedBlock] = []
+    have = 0
+    for b in blocks:
+        if b.rows == 0:
+            continue
+        pending.append(b)
+        have += b.rows
+        while have >= rows:
+            take, acc = rows, []
+            while take:
+                head = pending[0]
+                if head.rows <= take:
+                    acc.append(pending.pop(0))
+                    take -= head.rows
+                else:
+                    acc.append(slice_block(head, 0, take))
+                    pending[0] = slice_block(head, take, head.rows)
+                    take = 0
+            have -= rows
+            yield merge_blocks(acc)
+    if have:
+        yield merge_blocks(pending)
+
+
 def empty_block() -> ParsedBlock:
     """A zero-row block (a replay file where no line passed the filter)."""
     return ParsedBlock(

@@ -164,6 +164,7 @@ class BlockReplayFileSource(Source):
         num_retweet_end: int = 1000,
         block_bytes: int = 1 << 20,
         loop: bool = False,
+        copy: bool = True,
         **kw,
     ):
         super().__init__(**kw)
@@ -172,6 +173,10 @@ class BlockReplayFileSource(Source):
         self.end = num_retweet_end
         self.block_bytes = block_bytes
         self.loop = loop
+        # copy=False: blocks are views into per-call buffers (see
+        # native.parse_tweet_block) — for consumers that featurize each
+        # block promptly (the bench pipeline), not for accumulation
+        self.copy = copy
 
     def produce(self) -> Iterator:
         while True:
@@ -204,7 +209,7 @@ class BlockReplayFileSource(Source):
         from ..features import native
         from ..features.blocks import ParsedBlock
 
-        out = native.parse_tweet_block(data, self.begin, self.end)
+        out = native.parse_tweet_block(data, self.begin, self.end, copy=self.copy)
         if out is not None:
             numeric, units, offsets, ascii_flags, consumed, bad = out
             if bad:
@@ -230,7 +235,8 @@ class BlockReplayFileSource(Source):
         # line — pinned here so both block paths agree on adversarial
         # input (the object-ingest Status path keeps such rows)
         class _Obj(dict):
-            oversized = False
+            oversized = False  # a DIRECT text/full_text value too big
+            rt_oversized = False  # ANY retweeted_status value oversized
 
         def _pairs_hook(pairs):
             d = _Obj(pairs)
@@ -242,13 +248,18 @@ class BlockReplayFileSource(Source):
                     > MAX_TEXT_UNITS
                 ):
                     d.oversized = True
+                # any-occurrence, not last-wins: the C scanner caps EVERY
+                # duplicate retweeted_status occurrence, while dict(pairs)
+                # would keep only the last
+                if k == "retweeted_status" and getattr(v, "oversized", False):
+                    d.rt_oversized = True
             return d
 
         def oversized(obj) -> bool:
-            rt = obj.get("retweeted_status") if isinstance(obj, dict) else None
             # only the retweeted_status object's DIRECT text fields are
-            # bounded (the C parser skips all other strings uncapped)
-            return getattr(rt, "oversized", False)
+            # bounded (the C parser skips all other strings uncapped, incl.
+            # anything nested inside the retweeted status)
+            return getattr(obj, "rt_oversized", False)
 
         nl = data.rfind(b"\n")
         if nl < 0:

@@ -54,6 +54,39 @@ def _run_once(model, featurize, chunks, prefetch: bool):
     return time.perf_counter() - t0, last
 
 
+def measure_passes(
+    run_pass: Callable,
+    *,
+    repeats: int = 1,
+    time_budget_s: float | None = None,
+    settled_after: int = 0,
+):
+    """Best-of-N measurement core: call ``run_pass() -> (seconds, last)``
+    until ``repeats`` passes ran, then keep going while ``time_budget_s``
+    lasts unless ``settled_after`` consecutive passes failed to beat the
+    best by >2% — the stall-riding policy shared by every benchmark (the
+    accelerator tunnel stalls in multi-second bursts; one pass is never
+    trusted). Returns (best_seconds, last_output, passes)."""
+    t_start = time.perf_counter()
+    best_dt, final, passes, since_improve = None, None, 0, 0
+    while True:
+        dt, last = run_pass()
+        passes += 1
+        improved = best_dt is None or dt < best_dt * 0.98
+        best_dt = dt if best_dt is None else min(dt, best_dt)
+        since_improve = 0 if improved else since_improve + 1
+        final = last
+        if passes < max(1, repeats):
+            continue
+        if time_budget_s is None:
+            break
+        if settled_after and since_improve >= settled_after:
+            break
+        if time.perf_counter() - t_start >= time_budget_s:
+            break
+    return best_dt, final, passes
+
+
 def measure_pipeline(
     model,
     featurize: Callable,
@@ -92,29 +125,21 @@ def measure_pipeline(
     for _ in range(warmup_steps):
         model.step(warm).mse.block_until_ready()
 
-    t_start = time.perf_counter()
-    best_dt, final_mse, passes, since_improve = None, None, 0, 0
-    while True:
+    def run_pass():
         if resettable:
             model.reset()
-        dt, last = _run_once(model, featurize, chunks, prefetch)
-        passes += 1
-        improved = best_dt is None or dt < best_dt * 0.98
-        best_dt = dt if best_dt is None else min(dt, best_dt)
-        since_improve = 0 if improved else since_improve + 1
-        final_mse = float(last.mse)  # identical across passes when resettable
-        if passes < max(1, repeats):
-            continue
-        if time_budget_s is None:
-            break
-        if settled_after and since_improve >= settled_after:
-            break
-        if time.perf_counter() - t_start >= time_budget_s:
-            break
+        return _run_once(model, featurize, chunks, prefetch)
+
+    best_dt, last, passes = measure_passes(
+        run_pass,
+        repeats=repeats,
+        time_budget_s=time_budget_s,
+        settled_after=settled_after,
+    )
     return {
         "tweets_per_sec": n / best_dt,
         "seconds": best_dt,
         "batches": len(chunks),
-        "final_mse": final_mse,
+        "final_mse": float(last.mse),  # identical across passes w/ reset()
         "passes": passes,
     }
