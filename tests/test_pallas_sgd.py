@@ -1,15 +1,20 @@
-"""Pallas fused-SGD kernel parity (interpret mode on the CPU harness): the
-VMEM-resident loop must produce the same weights/predictions as the XLA
-sgd_inner_loop path for supported configurations."""
+"""Pallas fused-SGD reference kernel (interpret mode on the CPU harness):
+the VMEM-resident loop must track the XLA sgd_inner_loop path within the
+documented bf16-storage tolerance, honor the zeroed-padding contract, and
+gate itself to configurations that actually fit scoped VMEM on hardware
+(the round-1 kernel OOM'd on a real v5e at the flagship shape; the budget
+model now reflects measured usage — see ops/pallas_sgd.py)."""
 
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from twtml_tpu.features.batch import FeatureBatch
 from twtml_tpu.models.sgd import make_sgd_train_step, zero_weights
 from twtml_tpu.ops import pallas_sgd
+from twtml_tpu.ops.sparse import densify_text
 
 RNG = np.random.default_rng(11)
 F_TEXT = 60  # + 4 numeric = 64 → pads to 128 lanes
@@ -29,75 +34,132 @@ def make_batch(n=14, pad_to=16, tokens=6):
     return FeatureBatch(token_idx, token_val, numeric, label, mask)
 
 
-def run_step(use_pallas, batch, **kw):
-    import jax
+def dense_design(batch):
+    x_text = densify_text(
+        jnp.asarray(batch.token_idx), jnp.asarray(batch.token_val), F_TEXT
+    )
+    return jnp.concatenate(
+        [x_text, jnp.asarray(batch.numeric, dtype=jnp.float32)], axis=1
+    )
 
+
+def xla_reference(batch, **kw):
     step = jax.jit(
         make_sgd_train_step(
             num_text_features=F_TEXT,
             num_iterations=kw.pop("num_iterations", 30),
-            step_size=0.005,
-            use_pallas=use_pallas,
+            step_size=kw.pop("step_size", 0.005),
+            round_predictions=False,
             **kw,
         )
     )
     return step(zero_weights(F_TEXT), batch)
 
 
+@pytest.mark.parametrize("kw", [
+    {},
+    {"l2_reg": 0.1},
+    {"num_iterations": 5},
+    {"convergence_tol": 0.5},  # converges early; the freeze must match
+])
+def test_matches_xla_loop(kw):
+    batch = make_batch()
+    w_ref, out_ref = xla_reference(batch, **dict(kw))
+    w_pal, preds = pallas_sgd.fused_dense_sgd(
+        dense_design(batch),
+        jnp.asarray(batch.label),
+        jnp.asarray(batch.mask),
+        zero_weights(F_TEXT),
+        num_iterations=kw.get("num_iterations", 30),
+        step_size=0.005,
+        l2_reg=kw.get("l2_reg", 0.0),
+        convergence_tol=kw.get("convergence_tol", 0.001),
+    )
+    # bf16 storage of the design matrix: integer bigram counts are exact,
+    # the scaled numerics round — the documented ~1e-3 relative envelope
+    np.testing.assert_allclose(w_pal, w_ref, rtol=2e-3, atol=2e-3)
+    valid = batch.mask.astype(bool)
+    np.testing.assert_allclose(
+        np.asarray(preds)[valid],
+        np.asarray(out_ref.predictions)[valid],
+        rtol=2e-3, atol=2e-3,
+    )
+
+
+def test_padding_rows_do_not_leak():
+    """The kernel has no mask ref: zeroed padding rows must contribute
+    nothing. Same data, different pad_to → identical weights."""
+    small = make_batch(n=14, pad_to=16)
+    large = FeatureBatch(*(
+        np.concatenate([np.asarray(f), np.zeros((16,) + f.shape[1:], f.dtype)])
+        for f in small
+    ))
+    kw = dict(num_iterations=10, step_size=0.005)
+    w_a, _ = pallas_sgd.fused_dense_sgd(
+        dense_design(small), jnp.asarray(small.label), jnp.asarray(small.mask),
+        zero_weights(F_TEXT), **kw)
+    w_b, _ = pallas_sgd.fused_dense_sgd(
+        dense_design(large), jnp.asarray(large.label), jnp.asarray(large.mask),
+        zero_weights(F_TEXT), **kw)
+    np.testing.assert_allclose(w_a, w_b, rtol=1e-6, atol=1e-7)
+
+
+def test_masked_rows_zeroed_defensively():
+    """Even if a caller hands unzeroed garbage in masked rows, the call
+    masks features and labels before the kernel sees them."""
+    batch = make_batch(n=14, pad_to=16)
+    x = np.asarray(dense_design(batch))
+    x_dirty = x.copy()
+    x_dirty[14:] = np.nan  # NaN garbage: multiply-masking would poison all
+    label_dirty = np.asarray(batch.label).copy()
+    label_dirty[14:] = np.inf
+    kw = dict(num_iterations=10, step_size=0.005)
+    w_clean, _ = pallas_sgd.fused_dense_sgd(
+        jnp.asarray(x), jnp.asarray(batch.label), jnp.asarray(batch.mask),
+        zero_weights(F_TEXT), **kw)
+    w_dirty, _ = pallas_sgd.fused_dense_sgd(
+        jnp.asarray(x_dirty), jnp.asarray(label_dirty), jnp.asarray(batch.mask),
+        zero_weights(F_TEXT), **kw)
+    np.testing.assert_allclose(w_clean, w_dirty, rtol=1e-6, atol=1e-7)
+
+
+def test_empty_batch_no_update():
+    batch = make_batch(n=0)
+    w, preds = pallas_sgd.fused_dense_sgd(
+        dense_design(batch), jnp.asarray(batch.label), jnp.asarray(batch.mask),
+        zero_weights(F_TEXT), num_iterations=10, step_size=0.005)
+    assert np.all(np.asarray(w) == 0.0)
+    np.testing.assert_allclose(np.asarray(preds), 0.0, atol=1e-7)
+
+
 def test_supports_gating():
     assert pallas_sgd.padded_lanes(100) == 128
     assert pallas_sgd.padded_lanes(128) == 128
     assert pallas_sgd.supports(
-        batch_rows=16, num_features=128, mini_batch_fraction=1.0, dtype=jnp.float32
+        batch_rows=16, num_features=128, mini_batch_fraction=1.0,
+        dtype=jnp.float32,
     )
-    assert pallas_sgd.supports(  # unaligned F pads internally
-        batch_rows=16, num_features=100, mini_batch_fraction=1.0, dtype=jnp.float32
+    # the flagship operating point must fit the measured VMEM model
+    assert pallas_sgd.supports(
+        batch_rows=2048, num_features=1004, mini_batch_fraction=1.0,
+        dtype=jnp.float32,
     )
-    assert not pallas_sgd.supports(
-        batch_rows=16, num_features=128, mini_batch_fraction=0.5, dtype=jnp.float32
+    assert not pallas_sgd.supports(  # sampling unsupported
+        batch_rows=16, num_features=128, mini_batch_fraction=0.5,
+        dtype=jnp.float32,
     )
-    assert not pallas_sgd.supports(  # over VMEM budget
-        batch_rows=16, num_features=2**20, mini_batch_fraction=1.0, dtype=jnp.float32
+    assert not pallas_sgd.supports(  # over the scoped-VMEM budget
+        batch_rows=4096, num_features=2**14, mini_batch_fraction=1.0,
+        dtype=jnp.float32,
+    )
+    assert not pallas_sgd.supports(  # f32 weights only
+        batch_rows=16, num_features=128, mini_batch_fraction=1.0,
+        dtype=jnp.bfloat16,
     )
 
 
-def test_pallas_matches_xla_loop():
-    batch = make_batch()
-    w_pl, out_pl = run_step(True, batch)
-    w_xla, out_xla = run_step(False, batch)
-    np.testing.assert_allclose(np.asarray(w_pl), np.asarray(w_xla),
-                               rtol=1e-5, atol=1e-6)
-    np.testing.assert_allclose(
-        np.asarray(out_pl.predictions), np.asarray(out_xla.predictions), atol=1e-4
-    )
-    assert float(out_pl.mse) == pytest.approx(float(out_xla.mse), rel=1e-5)
-    assert float(out_pl.count) == float(out_xla.count)
-
-
-def test_pallas_l2_and_convergence_match():
-    batch = make_batch()
-    w_pl, _ = run_step(True, batch, l2_reg=0.05, convergence_tol=0.01)
-    w_xla, _ = run_step(False, batch, l2_reg=0.05, convergence_tol=0.01)
-    np.testing.assert_allclose(np.asarray(w_pl), np.asarray(w_xla),
-                               rtol=1e-5, atol=1e-6)
-
-
-def test_pallas_empty_batch_no_update():
-    batch = make_batch(n=0)
-    w_pl, out = run_step(True, batch)
-    assert np.all(np.asarray(w_pl) == 0.0)
-    assert float(out.count) == 0.0
-
-
-def test_direct_kernel_call_shapes():
-    x = RNG.normal(size=(16, 64)).astype(np.float32)
-    y = RNG.normal(size=(16,)).astype(np.float32)
-    m = np.ones((16,), np.float32)
-    w0 = np.zeros((64,), np.float32)
-    w, preds = pallas_sgd.fused_dense_sgd(
-        jnp.asarray(x), jnp.asarray(y), jnp.asarray(m), jnp.asarray(w0),
-        num_iterations=5, step_size=0.1,
-    )
-    assert w.shape == (64,)
-    assert preds.shape == (16,)
-    np.testing.assert_allclose(np.asarray(preds), 0.0, atol=1e-7)  # w0 = 0
+def test_vmem_estimate_is_the_gate():
+    """The flagship shape must clear the scoped-VMEM limit with the matrix
+    bytes accounted at bf16 plus vector-stripe overhead."""
+    est = pallas_sgd._vmem_estimate(2048, 1024)
+    assert 2 * 2048 * 1024 * 2 < est <= pallas_sgd.VMEM_LIMIT_BYTES

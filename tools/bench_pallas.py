@@ -1,0 +1,119 @@
+"""Microbenchmark: XLA-compiled SGD inner loop vs the pallas VMEM-resident
+kernel (ops/pallas_sgd.py) at the flagship operating point.
+
+Measurement methodology — this build's TPU attaches through a tunnel whose
+``block_until_ready`` does NOT wait for device execution (a no-op sync: a
+4096³ matmul "measures" 50+ PFLOP/s that way), and whose per-dispatch
+overhead is milliseconds. The only honest per-step timing is CHAINED
+dispatches with one host fetch at the end: run K data-dependent steps, fetch
+a scalar, divide. Even then the resolution floor is the dispatch pipeline,
+~100 µs/step — far above the actual device time of either implementation at
+2048×1024×50 iterations — so expect both rows to read the same. That
+equality IS the result: the kernel is validated and VMEM-fits on hardware,
+and no measurable win exists at this model size (BENCHMARKS.md).
+
+Usage: python tools/bench_pallas.py [--rows 2048] [--features 1024]
+       [--iters 50] [--chain 32]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    rows, features, iters, chain = 2048, 1024, 50, 32
+    i = 0
+    while i < len(args):
+        if args[i] == "--rows":
+            rows = int(args[i + 1]); i += 2
+        elif args[i] == "--features":
+            features = int(args[i + 1]); i += 2
+        elif args[i] == "--iters":
+            iters = int(args[i + 1]); i += 2
+        elif args[i] == "--chain":
+            chain = int(args[i + 1]); i += 2
+        else:
+            raise SystemExit(f"unknown flag {args[i]!r}")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from twtml_tpu.ops import pallas_sgd
+
+    rng = np.random.default_rng(0)
+    x = np.zeros((rows, features), np.float32)
+    idx = rng.integers(0, features - 4, size=(rows, 40))
+    for r in range(rows):
+        np.add.at(x[r], idx[r], 1.0)
+    x[:, -4:] = rng.normal(size=(rows, 4)).astype(np.float32) * 0.1
+    X = jnp.asarray(x)
+    y = jnp.asarray(rng.uniform(100, 1000, size=(rows,)).astype(np.float32))
+    m = jnp.ones((rows,), jnp.float32)
+    w0 = jnp.zeros((features,), jnp.float32)
+
+    def xla_loop(X, y, m, w):
+        count = jnp.sum(m)
+        denom = jnp.maximum(count, 1.0)
+
+        def body(i, carry):
+            w, conv = carry
+            it = i + 1
+            r = (X @ w - y) * m
+            g = (r @ X) / denom
+            eta = 0.005 / jnp.sqrt(jnp.float32(it))
+            w_new = w - eta * g
+            delta = jnp.sqrt(jnp.sum((w_new - w) ** 2))
+            nn = jnp.sqrt(jnp.sum(w_new * w_new))
+            conv_now = (count > 0) & (delta < 0.001 * jnp.maximum(nn, 1.0))
+            return jnp.where(conv, w, w_new), conv | conv_now
+
+        w_final, _ = lax.fori_loop(0, iters, body, (w, jnp.array(False)))
+        return w_final
+
+    xla_fn = jax.jit(xla_loop)
+    pal_fn = jax.jit(
+        lambda X, y, m, w: pallas_sgd.fused_dense_sgd(
+            X, y, m, w, num_iterations=iters, step_size=0.005
+        )[0]
+    )
+
+    def chained(fn) -> float:
+        """Seconds per step over `chain` data-dependent dispatches, best of 3
+        (the fetch at the end forces real completion)."""
+        w = fn(X, y, m, w0)
+        float(w[0])  # warm compile + transport
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            w = w0
+            for _ in range(chain):
+                w = fn(X, y, m, w)  # w chains: no overlap, honest total
+            float(w[0])
+            best = min(best, (time.perf_counter() - t0) / chain)
+        return best
+
+    t_xla = chained(xla_fn)
+    t_pal = chained(pal_fn)
+    diff = float(jnp.max(jnp.abs(xla_fn(X, y, m, w0) - pal_fn(X, y, m, w0))))
+    for name, t in (("xla_fori_loop", t_xla), ("pallas_vmem_resident", t_pal)):
+        print(json.dumps({
+            "impl": name,
+            "ms_per_step_upper_bound": round(t * 1000, 3),
+            "rows": rows, "features": features, "iters": iters,
+            "chain": chain,
+            "note": "dispatch-pipeline floor dominates; see module docstring",
+        }))
+    print(json.dumps({"max_abs_weight_diff": diff}))
+
+
+if __name__ == "__main__":
+    main()

@@ -147,7 +147,6 @@ def make_sgd_train_step(
     axis_name: str | None = None,
     use_sparse: bool | None = None,
     round_predictions: bool = True,
-    use_pallas: bool | None = None,
 ):
     """Build the fused (weights, batch) → (new_weights, StepOutput) step.
 
@@ -157,26 +156,17 @@ def make_sgd_train_step(
     The returned function is pure and jit/shard_map-composable; wrap with
     ``jax.jit(..., donate_argnums=0)`` to keep weights HBM-resident.
 
-    ``use_pallas``: route the dense inner loop through the VMEM-resident
-    pallas kernel (ops/pallas_sgd.py) when the configuration supports it
-    (dense least-squares, fraction 1.0, single shard, f32). Default: OFF —
-    measured on TPU v5e, XLA's compiled loop beats the hand kernel for these
-    matvec shapes (0.62 ms vs 33 ms per 50-iteration step at 2048×1024; the
-    [B,F]×[F,1] matvec uses 1/128 of the MXU and XLA pipelines it better),
-    so the kernel stays an opt-in reference implementation.
+    The inner loop is always the XLA-compiled ``sgd_inner_loop``. A
+    VMEM-resident pallas variant exists as reference code
+    (ops/pallas_sgd.py, semantics pinned by tests) but is deliberately NOT a
+    knob here: at these shapes the step is micro-seconds on device for both
+    implementations and the difference is unmeasurable through this build's
+    dispatch transport — see BENCHMARKS.md for the full measurement story.
     """
     f_text = num_text_features
     sparse = f_text > DENSE_TEXT_FEATURE_LIMIT if use_sparse is None else use_sparse
-    least_squares = residual_fn is None and prediction_fn is None
     residual_fn = residual_fn or (lambda raw, label: raw - label)
     prediction_fn = prediction_fn or (lambda raw: raw)
-    pallas_candidate = (
-        bool(use_pallas)
-        and not sparse
-        and least_squares
-        and axis_name is None
-        and mini_batch_fraction >= 1.0
-    )
 
     def _predict_raw(weights, batch: FeatureBatch, x_dense):
         if sparse:
@@ -227,29 +217,6 @@ def make_sgd_train_step(
                 ],
                 axis=1,
             )
-
-        # ---- pallas fast path: whole loop in one VMEM-resident kernel ----
-        if pallas_candidate:
-            from ..ops import pallas_sgd
-
-            if pallas_sgd.supports(
-                batch_rows=x_dense.shape[0],
-                num_features=x_dense.shape[1],
-                mini_batch_fraction=mini_batch_fraction,
-                dtype=dtype,
-            ):
-                w_final, raw = pallas_sgd.fused_dense_sgd(
-                    x_dense, labels, mask, weights,
-                    num_iterations=num_iterations,
-                    step_size=step_size,
-                    l2_reg=l2_reg,
-                    convergence_tol=convergence_tol,
-                )
-                preds = raw
-                if round_predictions:
-                    preds = jnp_round_half_up(preds)
-                stats = batch_stats(labels, preds, mask, axis_name)
-                return w_final, StepOutput(predictions=preds, **stats)
 
         # ---- predict + stats with pre-update weights --------------------
         raw = _predict_raw(weights, batch, x_dense)

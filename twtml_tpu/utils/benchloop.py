@@ -36,7 +36,13 @@ def _usable_cpus() -> int:
 
 
 def _run_once(model, featurize, chunks, prefetch: bool):
-    """One timed pass; returns (elapsed seconds, last StepOutput)."""
+    """One timed pass; returns (elapsed seconds, last StepOutput).
+
+    The pass ends with a REAL host fetch of the last step's mse: on this
+    build's tunnel transport ``block_until_ready`` does not wait for device
+    execution (BENCHMARKS.md), and the model's weights chain through every
+    step, so one scalar fetch at the end is the cheapest way to make the
+    timed window include actual completion of the whole pass."""
     t0 = time.perf_counter()
     if prefetch:
         with ThreadPoolExecutor(max_workers=1) as pool:
@@ -46,11 +52,12 @@ def _run_once(model, featurize, chunks, prefetch: bool):
                 pending = pool.submit(featurize, nxt)
                 model.step(batch).mse.block_until_ready()
             last = model.step(pending.result())
-            last.mse.block_until_ready()
     else:
+        last = None
         for chunk in chunks:
             last = model.step(featurize(chunk))
             last.mse.block_until_ready()
+    float(last.mse)  # force completion inside the timed window
     return time.perf_counter() - t0, last
 
 
