@@ -219,6 +219,44 @@ int32_t pad_units_batch(const uint16_t* units, const int64_t* offsets,
   return max_len;
 }
 
+// uint8 variant of pad_units_batch: the narrow wire format for batches the
+// caller KNOWS are byte-ranged (every row ASCII-flagged by the parser /
+// isascii() on the host path) — host→device transfer is the streaming hot
+// loop's bottleneck and the units buffer is its largest tensor, so the
+// narrow pad halves it with zero extra scans. Units >= 256 must not reach
+// this function (the caller's ascii gate guarantees < 128).
+int32_t pad_units_batch_u8(const uint16_t* units, const int64_t* offsets,
+                           int32_t batch, int32_t padded_rows, int32_t l_max,
+                           int32_t ascii_lower, uint8_t* out_units,
+                           int32_t* out_len) {
+  int32_t max_len = 0;
+  for (int32_t b = 0; b < batch; ++b) {
+    const int64_t start = offsets[b];
+    const int64_t len = offsets[b + 1] - start;
+    max_len = std::max(max_len, static_cast<int32_t>(len));
+    const int64_t n = std::min<int64_t>(len, l_max);
+    uint8_t* row = out_units + static_cast<int64_t>(b) * l_max;
+    if (ascii_lower) {
+      for (int64_t i = 0; i < n; ++i) {
+        const uint16_t u = units[start + i];
+        row[i] = static_cast<uint8_t>((u >= 'A' && u <= 'Z') ? u + 32 : u);
+      }
+    } else {
+      for (int64_t i = 0; i < n; ++i)
+        row[i] = static_cast<uint8_t>(units[start + i]);
+    }
+    std::memset(row + n, 0, l_max - n);
+    out_len[b] = static_cast<int32_t>(n);
+  }
+  if (padded_rows > batch) {
+    std::memset(out_units + static_cast<int64_t>(batch) * l_max, 0,
+                static_cast<int64_t>(padded_rows - batch) * l_max);
+    std::memset(out_len + batch, 0,
+                (padded_rows - batch) * sizeof(int32_t));
+  }
+  return max_len;
+}
+
 // Lexicon sentiment scorer over raw UTF-16 units (features/sentiment.py's
 // C hot path). Tokenization matches the Python `[a-z']+` regex over
 // lowercased text for ASCII rows: A-Z fold inline, every other unit is a

@@ -85,7 +85,7 @@ def test_unit_batch_densifies_identically(statuses, feat):
     """Dense [B, F] matrices from both wire formats are equal elementwise."""
     host = feat.featurize_batch(statuses)
     dev = feat.featurize_batch_units(statuses)
-    assert dev.units.dtype == np.uint16
+    assert dev.units.dtype in (np.uint8, np.uint16)  # rules: TestCompactUnitsWire
     np.testing.assert_array_equal(host.mask, dev.mask)
     np.testing.assert_array_equal(host.label, dev.label)
     np.testing.assert_allclose(host.numeric, dev.numeric, rtol=1e-6)
@@ -116,6 +116,56 @@ def test_unit_batch_empty():
     batch = feat.featurize_batch_units([])
     assert batch.mask.sum() == 0
     assert batch.units.shape[1] >= 2  # device bigram window needs L >= 2
+    assert batch.units.dtype == np.uint8  # all-zero pad takes the u8 wire
+
+
+class TestCompactUnitsWire:
+    """uint8 units for byte-ranged batches (the transfer-bound wire
+    optimization): dtype rule, feature parity, and training parity."""
+
+    def test_ascii_batch_ships_uint8(self, feat):
+        batch = feat.featurize_batch_units(
+            [_status_with_text("plain ascii tweet!")], pre_filtered=True
+        )
+        assert batch.units.dtype == np.uint8
+
+    def test_non_ascii_batch_ships_uint16(self, feat):
+        # the gate is metadata (isascii), not a data sniff: even Latin-1
+        # texts whose units would fit a byte keep the wide wire
+        batch = feat.featurize_batch_units(
+            [_status_with_text("café résumé")], pre_filtered=True  # é = 0xE9
+        )
+        assert batch.units.dtype == np.uint16
+        batch = feat.featurize_batch_units(
+            [_status_with_text("ΣΙΓΜΑ")], pre_filtered=True
+        )
+        assert batch.units.dtype == np.uint16
+
+    def test_mixed_batch_ships_uint16(self, feat):
+        batch = feat.featurize_batch_units(
+            [_status_with_text("plain"), _status_with_text("emoji \U0001f600")],
+            pre_filtered=True,
+        )
+        assert batch.units.dtype == np.uint16
+
+    def test_block_path_dtype_follows_ascii_flags(self, feat):
+        from twtml_tpu.features.blocks import merge_blocks
+        from twtml_tpu.streaming.sources import BlockReplayFileSource
+
+        merged = merge_blocks(list(BlockReplayFileSource(DATA).produce()))
+        batch = feat.featurize_parsed_block(merged)
+        want = np.uint8 if merged.ascii.all() else np.uint16
+        assert batch.units.dtype == want
+
+    def test_uint8_wire_trains_identically(self, feat, statuses):
+        """Force both wire dtypes over the same tweets: identical weights."""
+        batch = feat.featurize_batch_units(statuses)
+        wide = batch._replace(units=batch.units.astype(np.uint16))
+        a = StreamingLinearRegressionWithSGD(num_iterations=10)
+        b = StreamingLinearRegressionWithSGD(num_iterations=10)
+        out_a, out_b = a.step(batch), b.step(wide)
+        assert float(out_a.mse) == float(out_b.mse)
+        np.testing.assert_array_equal(a.latest_weights, b.latest_weights)
 
 
 def test_unit_batch_accent_normalization():

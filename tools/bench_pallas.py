@@ -45,7 +45,6 @@ def main(argv=None) -> None:
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax import lax
 
     from twtml_tpu.ops import pallas_sgd
 
@@ -61,23 +60,26 @@ def main(argv=None) -> None:
     w0 = jnp.zeros((features,), jnp.float32)
 
     def xla_loop(X, y, m, w):
-        count = jnp.sum(m)
-        denom = jnp.maximum(count, 1.0)
+        # drive the CANONICAL inner loop (models/sgd.py is the one place
+        # the parity-critical semantics live) so the comparison can never
+        # drift from the shipped path
+        from twtml_tpu.models.sgd import sampling_key, sgd_inner_loop
 
-        def body(i, carry):
-            w, conv = carry
-            it = i + 1
-            r = (X @ w - y) * m
-            g = (r @ X) / denom
-            eta = 0.005 / jnp.sqrt(jnp.float32(it))
-            w_new = w - eta * g
-            delta = jnp.sqrt(jnp.sum((w_new - w) ** 2))
-            nn = jnp.sqrt(jnp.sum(w_new * w_new))
-            conv_now = (count > 0) & (delta < 0.001 * jnp.maximum(nn, 1.0))
-            return jnp.where(conv, w, w_new), conv | conv_now
+        def grad_and_count(wv, sel):
+            residual = (X @ wv - y) * sel
+            return X.T @ residual, jnp.sum(sel)
 
-        w_final, _ = lax.fori_loop(0, iters, body, (w, jnp.array(False)))
-        return w_final
+        return sgd_inner_loop(
+            w,
+            num_iterations=iters,
+            step_size=0.005,
+            mini_batch_fraction=1.0,
+            l2_reg=0.0,
+            convergence_tol=0.001,
+            mask=m,
+            sample_key=sampling_key(None, 1.0),
+            grad_and_count=grad_and_count,
+        )
 
     xla_fn = jax.jit(xla_loop)
     pal_fn = jax.jit(

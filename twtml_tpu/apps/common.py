@@ -177,8 +177,18 @@ def warmup_compile(stream, model) -> None:
         return
     import time as _time
 
+    import numpy as np
+
+    from ..features.batch import UnitBatch
+
     t0 = _time.perf_counter()
-    model.step(stream.featurize_empty())
+    empty = stream.featurize_empty()
+    model.step(empty)
+    if isinstance(empty, UnitBatch) and empty.units.dtype == np.uint8:
+        # the units wire dtype is per-batch (uint8 for Latin-1 batches,
+        # uint16 otherwise — featurizer._pad_ragged_units): warm BOTH
+        # programs so a stream's first emoji tweet doesn't stall mid-flight
+        model.step(empty._replace(units=empty.units.astype(np.uint16)))
     log.info(
         "pre-compiled the train step for buckets (%d, %d) in %.1fs",
         stream.row_bucket, stream.token_bucket, _time.perf_counter() - t0,

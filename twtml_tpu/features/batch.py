@@ -55,7 +55,10 @@ class UnitBatch(NamedTuple):
     bit-identical features (same Java-hashCode bigram hash).
 
     Shapes (B = padded rows, L = padded units/tweet, L ≥ 2):
-      units:   uint16 [B, L]   — lowercased text as UTF-16-LE code units
+      units:   uint8|uint16 [B, L] — lowercased text as UTF-16-LE code
+               units; ships uint8 when every row is ASCII (metadata-gated,
+               the common case — halves the dominant wire tensor; the
+               device hash upcasts to int32 either way)
       length:  int32  [B]      — real unit count per row (0 for padding)
       numeric: float32[B, 4], label: float32[B], mask: float32[B] — as in
       FeatureBatch.

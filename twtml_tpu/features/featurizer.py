@@ -72,14 +72,27 @@ def _pad_ragged_units(
     n: int,
     b: int,
     lu: int,
+    narrow: bool = False,
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Ragged UTF-16 units → ([b, lu] uint16 buffer, [b] int32 lengths) with
-    ASCII case folded — C row-copy fast path, numpy gather fallback. Shared
-    by both UnitBatch builders (Status lists and columnar blocks)."""
+    """Ragged UTF-16 units → ([b, lu] buffer, [b] int32 lengths) with ASCII
+    case folded — C row-copy fast path, numpy gather fallback. Shared by
+    both UnitBatch builders (Status lists and columnar blocks).
+
+    ``narrow=True`` ships the buffer as uint8 — the half-width wire format
+    for batches every caller-known-ASCII row fits (the overwhelmingly common
+    case). Host→device transfer is the measured bottleneck of the streaming
+    hot loop and the units buffer is its largest tensor, so this halves the
+    dominant wire cost with ZERO extra data passes: the flag comes from
+    metadata both builders already have (parser ascii flags / isascii), the
+    narrow write happens inside the same C pad copy, and the device hash
+    upcasts to int32 either way (ops/text_hash.py) — identical features. A
+    stream mixing both dtypes compiles at most one extra program
+    (apps/common.warmup_compile warms both)."""
     from . import native
 
     padded = (
-        native.pad_units((units, offsets), n, b, lu, ascii_lower=True)
+        native.pad_units((units, offsets), n, b, lu, ascii_lower=True,
+                         narrow=narrow)
         if n
         else None
     )
@@ -95,6 +108,8 @@ def _pad_ragged_units(
         length[:n] = lengths
         upper = (buf >= 65) & (buf <= 90)
         buf[upper] += 32
+    if narrow:
+        buf = buf.astype(np.uint8)
     return buf, length
 
 
@@ -388,15 +403,20 @@ class Featurizer:
         originals = [s.retweeted_status for s in keep]
         if self.normalize_accents:
             texts = [_strip_accents(o.text.lower()) for o in originals]
+            all_ascii = all(t.isascii() for t in texts)
         else:
             # case-folding strategy: texts with non-ASCII chars need
             # Python's Unicode lower(); pure-ASCII texts (the common case)
             # are folded for free during the pad copy ('A'-'Z'+32, and
             # re-folding the pre-lowered rows' ASCII range is idempotent)
-            texts = [
-                t if t.isascii() else t.lower()
-                for t in (o.text for o in originals)
-            ]
+            all_ascii = True
+            texts = []
+            for o in originals:
+                t = o.text
+                if not t.isascii():
+                    t = t.lower()
+                    all_ascii = False
+                texts.append(t)
         units, offsets = native.encode_texts(texts)  # pure numpy, C-free
         lengths = np.diff(offsets).astype(np.int32)
         max_len = int(lengths.max()) if n else 0
@@ -407,7 +427,9 @@ class Featurizer:
             if unit_bucket >= max(max_len, 2) and unit_bucket > 0
             else _bucket(max(max_len, 2))
         )
-        buf, length = _pad_ragged_units(units, offsets, lengths, n, b, lu)
+        buf, length = _pad_ragged_units(
+            units, offsets, lengths, n, b, lu, narrow=all_ascii
+        )
         # the encode is reusable by a batched labeler only when it reflects
         # the plain lowercased text (accent stripping changes the tokens)
         enc = (units, offsets) if not self.normalize_accents else None
@@ -498,7 +520,12 @@ class Featurizer:
             if unit_bucket >= max(max_len, 2) and unit_bucket > 0
             else _bucket(max(max_len, 2))
         )
-        buf, length = _pad_ragged_units(units, offsets, lengths, n, b, lu)
+        # narrow wire iff every row is parser-ASCII-flagged: redo rows are
+        # exactly the non-ASCII ones (normalize_accents marks all rows redo,
+        # so it conservatively keeps the wide wire) — metadata, never sniffed
+        buf, length = _pad_ragged_units(
+            units, offsets, lengths, n, b, lu, narrow=n == 0 or redo.size == 0
+        )
 
         now = self.now_ms if self.now_ms is not None else int(time.time() * 1000)
         numeric = np.zeros((b, NUM_NUMBER_FEATURES), dtype=np.float32)

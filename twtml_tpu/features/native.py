@@ -124,6 +124,17 @@ def _load(path: str) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_uint16),  # out_units
         ctypes.POINTER(ctypes.c_int32),  # out_len
     ]
+    lib.pad_units_batch_u8.restype = ctypes.c_int32
+    lib.pad_units_batch_u8.argtypes = [
+        ctypes.POINTER(ctypes.c_uint16),  # units
+        ctypes.POINTER(ctypes.c_int64),  # offsets
+        ctypes.c_int32,  # batch
+        ctypes.c_int32,  # padded_rows
+        ctypes.c_int32,  # l_max
+        ctypes.c_int32,  # ascii_lower
+        ctypes.POINTER(ctypes.c_uint8),  # out_units
+        ctypes.POINTER(ctypes.c_int32),  # out_len
+    ]
     lib.lexicon_score_batch.restype = None
     lib.lexicon_score_batch.argtypes = [
         ctypes.POINTER(ctypes.c_uint16),  # units
@@ -209,25 +220,33 @@ def pad_units(
     padded_rows: int,
     l_max: int,
     ascii_lower: bool = False,
+    narrow: bool = False,
 ) -> tuple[np.ndarray, np.ndarray] | None:
-    """Ragged (units, offsets) → ([padded_rows, l_max] uint16, [padded_rows]
+    """Ragged (units, offsets) → ([padded_rows, l_max] units, [padded_rows]
     int32 lengths) via the C row-memcpy loop; None if the library is
     unavailable (caller falls back to the numpy gather). ``ascii_lower``
-    folds 'A'-'Z' during the copy (see pad_units_batch)."""
+    folds 'A'-'Z' during the copy. ``narrow`` writes a uint8 buffer — the
+    half-width wire format for batches the caller KNOWS are byte-ranged
+    (ascii-flagged rows); it is metadata-driven, never sniffed from data."""
     lib = get_lib()
     if lib is None:
         return None
     units, offsets = encoded
-    buf = np.empty((padded_rows, l_max), dtype=np.uint16)
+    if narrow:
+        buf: np.ndarray = np.empty((padded_rows, l_max), dtype=np.uint8)
+        fn, ptr_t = lib.pad_units_batch_u8, ctypes.c_uint8
+    else:
+        buf = np.empty((padded_rows, l_max), dtype=np.uint16)
+        fn, ptr_t = lib.pad_units_batch, ctypes.c_uint16
     length = np.empty((padded_rows,), dtype=np.int32)
-    max_len = lib.pad_units_batch(
+    max_len = fn(
         units.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         n,
         padded_rows,
         l_max,
         1 if ascii_lower else 0,
-        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        buf.ctypes.data_as(ctypes.POINTER(ptr_t)),
         length.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
     if max_len > l_max:  # caller sized l_max from these offsets; never expected

@@ -82,6 +82,13 @@ def host_local_batch_to_global(
         from .sharding import shard_batch
 
         return shard_batch(batch, mesh)
+    if isinstance(batch, UnitBatch) and batch.units.dtype != np.uint16:
+        # the units wire dtype is sniffed per batch (uint8 for Latin-1
+        # batches, featurizer._pad_ragged_units); cross-process assembly
+        # needs ONE dtype on every host, and hosts see different shards —
+        # harmonize to the full uint16 schema here (multi-host intake rides
+        # DCN, not the single-host transport the downcast optimizes)
+        batch = batch._replace(units=batch.units.astype(np.uint16))
     specs = _pspecs_for(type(batch), mesh.axis_names[0])
     arrays = []
     for host_arr, spec in zip(batch, specs):

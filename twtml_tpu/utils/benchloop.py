@@ -2,18 +2,22 @@
 
 The pipeline under test is the streaming hot path: featurize chunk k+1 on a
 host thread while the device runs chunk k (SURVEY.md §7 hard part (c) —
-hiding host featurization latency behind device steps). Two measured-on-TPU
-policies baked in:
+hiding host featurization latency behind device steps). Measured-on-TPU
+policies baked in (r2 — the transport's behavior changed since round 1 and
+the r1 policy notes no longer hold):
 
-- **Per-step sync.** Each step's stats are fetched before the next dispatch,
-  exactly like the real streaming loop (telemetry consumes every batch's
-  Stats, SessionStats.scala:22-34). It is also required for honest timing
-  over a remote-tunnel device: even a depth-2 dispatch queue floods the
-  transport and collapses throughput ~2x (measured).
-- **Prefetch pays whenever the device sync is not host-CPU work.** On an
-  accelerator backend ``block_until_ready`` is GIL-released transport/IO
-  wait, so a featurize thread overlaps with it even on a single-CPU host
-  (measured 2x). Only on the CPU backend with one usable CPU does the
+- **Dispatch freely, fetch once per pass.** On this build's tunnel
+  transport, ``block_until_ready`` is NOT a cheap sync: with per-step
+  argument uploads in flight it forces a ~70 ms round trip per call
+  (32-step pass: ~2.5 s synced vs ~0.25 s dispatched), while plain
+  dispatches pipeline. Conversely it does not reliably wait either (a
+  4096³ matmul "completes" in 18 µs by that clock). So a timed pass issues
+  every dispatch without syncing and ends with ONE real host fetch of the
+  last step's mse — the weights chain through every step, so that single
+  scalar closes the window over actual completion of the whole pass.
+- **Prefetch pays whenever the device step is not host-CPU work.** A
+  featurize thread overlaps with dispatch/transfer waits even on a
+  single-CPU host. Only on the CPU backend with one usable CPU does the
   worker thread purely add GIL churn — the loop runs inline there.
 """
 
@@ -36,13 +40,8 @@ def _usable_cpus() -> int:
 
 
 def _run_once(model, featurize, chunks, prefetch: bool):
-    """One timed pass; returns (elapsed seconds, last StepOutput).
-
-    The pass ends with a REAL host fetch of the last step's mse: on this
-    build's tunnel transport ``block_until_ready`` does not wait for device
-    execution (BENCHMARKS.md), and the model's weights chain through every
-    step, so one scalar fetch at the end is the cheapest way to make the
-    timed window include actual completion of the whole pass."""
+    """One timed pass; returns (elapsed seconds, last StepOutput). Dispatch
+    freely, one real fetch at the end — see the module docstring."""
     t0 = time.perf_counter()
     if prefetch:
         with ThreadPoolExecutor(max_workers=1) as pool:
@@ -50,13 +49,12 @@ def _run_once(model, featurize, chunks, prefetch: bool):
             for nxt in chunks[1:]:
                 batch = pending.result()
                 pending = pool.submit(featurize, nxt)
-                model.step(batch).mse.block_until_ready()
+                model.step(batch)
             last = model.step(pending.result())
     else:
         last = None
         for chunk in chunks:
             last = model.step(featurize(chunk))
-            last.mse.block_until_ready()
     float(last.mse)  # force completion inside the timed window
     return time.perf_counter() - t0, last
 
