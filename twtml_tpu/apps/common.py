@@ -185,9 +185,9 @@ def warmup_compile(stream, model) -> None:
     empty = stream.featurize_empty()
     model.step(empty)
     if isinstance(empty, UnitBatch) and empty.units.dtype == np.uint8:
-        # the units wire dtype is per-batch (uint8 for Latin-1 batches,
-        # uint16 otherwise — featurizer._pad_ragged_units): warm BOTH
-        # programs so a stream's first emoji tweet doesn't stall mid-flight
+        # the units wire dtype is per-batch metadata (uint8 iff every row
+        # is ASCII — featurizer._pad_ragged_units): warm BOTH programs so
+        # a stream's first non-ASCII tweet doesn't stall mid-flight
         model.step(empty._replace(units=empty.units.astype(np.uint16)))
     log.info(
         "pre-compiled the train step for buckets (%d, %d) in %.1fs",

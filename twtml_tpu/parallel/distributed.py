@@ -83,11 +83,11 @@ def host_local_batch_to_global(
 
         return shard_batch(batch, mesh)
     if isinstance(batch, UnitBatch) and batch.units.dtype != np.uint16:
-        # the units wire dtype is sniffed per batch (uint8 for Latin-1
-        # batches, featurizer._pad_ragged_units); cross-process assembly
+        # the units wire dtype is per-batch metadata (uint8 iff every row
+        # is ASCII, featurizer._pad_ragged_units); cross-process assembly
         # needs ONE dtype on every host, and hosts see different shards —
         # harmonize to the full uint16 schema here (multi-host intake rides
-        # DCN, not the single-host transport the downcast optimizes)
+        # DCN, not the single-host transport the narrow wire optimizes)
         batch = batch._replace(units=batch.units.astype(np.uint16))
     specs = _pspecs_for(type(batch), mesh.axis_names[0])
     arrays = []
