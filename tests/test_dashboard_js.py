@@ -1,0 +1,350 @@
+"""The shipped dashboard JavaScript, EXECUTED (VERDICT r1 #5).
+
+The reference declared browser tests and commented them out
+(WebTestSuite.scala:7,44-52); this build image has no JS runtime at all, so
+these tests run the REAL asset files (web/assets/js/*.js, untouched) on the
+in-repo jsmini interpreter (tools/jsmini.py) against a stub DOM whose
+elements come from the REAL index.html/test.html id attributes
+(tools/jsdom.py). A broken jsonClass dispatch, a renamed counter id, or a
+syntax error in any shipped asset fails here. Parsing every file also
+replaces the reference's sbt-jshint asset lint (web/build.sbt:25-39).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.jsdom import Harness  # noqa: E402
+from tools.jsmini import parse  # noqa: E402
+
+ASSETS = os.path.join(REPO, "twtml_tpu", "web", "assets")
+JS = os.path.join(ASSETS, "js")
+ALL_JS = ["api.js", "chart.js", "index.js", "test.js"]
+
+
+def js_path(name):
+    return os.path.join(JS, name)
+
+
+# ---------------------------------------------------------------------------
+# lint: every shipped asset parses (the sbt-jshint analog)
+
+@pytest.mark.parametrize("name", ALL_JS)
+def test_shipped_js_parses(name):
+    with open(js_path(name), encoding="utf-8") as fh:
+        parse(fh.read())
+
+
+# ---------------------------------------------------------------------------
+# dashboard page (index.html + api.js + chart.js + index.js)
+
+def dashboard(defer_series=False):
+    h = Harness([os.path.join(ASSETS, "index.html")])
+    h.fetch_routes["/api/stats"] = {
+        "jsonClass": "Stats", "count": 0, "batch": 0, "mse": 0,
+        "realStddev": 0, "predStddev": 0,
+    }
+    series = h.defer("/api/series") if defer_series else None
+    if not defer_series:
+        h.fetch_routes["/api/series"] = []
+    for name in ("api.js", "chart.js", "index.js"):
+        h.load_script(js_path(name))
+    h.dom_content_loaded()
+    return (h, series) if defer_series else h
+
+
+def frame(**kw):
+    return json.dumps(kw)
+
+
+def test_boot_opens_websocket_and_backfills():
+    h = dashboard()
+    assert len(h.websockets) == 1
+    assert h.ws.url == "ws://localhost:8888/api"
+    urls = [u for u, _ in h.fetches]
+    assert "/api/stats" in urls and "/api/series" in urls
+
+
+def test_socket_badge_lifecycle():
+    h = dashboard()
+    h.ws.server_open()
+    assert h.el("conn").text == "live"
+    assert "live" in h.el("conn").class_set
+    h.ws.server_close()
+    assert h.el("conn").text == "offline"
+    assert "live" not in h.el("conn").class_set
+
+
+def test_stats_frame_updates_all_five_counters():
+    """The five counter ids are the reference's wire contract
+    (index.html:46-67, js/index.js:55-61)."""
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="Stats", count=1234567, batch=678, mse=4321,
+        realStddev=15, predStddev=25,
+    ))
+    assert h.el("count").text == "1,234,567"  # toLocaleString
+    assert h.el("batch").text == "678"
+    assert h.el("mse").text == "4,321"
+    assert h.el("realStddev").text == "15"
+    assert h.el("predStddev").text == "25"
+
+
+def test_config_frame_resets_counters_and_rebuilds_iframes():
+    """Config: counters reset, session label set, one iframe per viz id with
+    the reference's pym URL shape (js/index.js:35-43)."""
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="Stats", count=9, batch=9, mse=9, realStddev=9, predStddev=9,
+    ))
+    h.ws.server_message(frame(
+        jsonClass="Config", id="sess-1", host="http://lightning",
+        viz=["101", "102"],
+    ))
+    for el_id in ("count", "batch", "mse", "realStddev", "predStddev"):
+        assert h.el(el_id).text == "0"
+    assert h.el("session").text == "sess-1"
+    frames = h.el("graphs").children
+    assert [f.tag for f in frames] == ["iframe", "iframe"]
+    assert [f.get("src") for f in frames] == [
+        "http://lightning/visualizations/101/pym",
+        "http://lightning/visualizations/102/pym",
+    ]
+
+
+def test_unknown_jsonclass_is_ignored():
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(jsonClass="Mystery", whatever=1))
+    assert h.el("count").text == "0" or h.el("count").text == ""
+
+
+def test_series_frames_drive_the_chart():
+    h = dashboard()
+    h.ws.server_open()
+    ctx = h.el("livechart").ctx
+    ctx.calls.clear()
+    h.ws.server_message(frame(
+        jsonClass="Series", real=[100, 200, 300], pred=[110, 190, 310],
+        realStddev=15, predStddev=25,
+    ))
+    # 4 series drawn: real, pred, and both stdev bands
+    assert len(ctx.ops("stroke")) == 4
+    assert len(ctx.ops("lineTo")) > 0
+    # legend labels drawn
+    texts = [args[0] for op, args in ctx.ops("fillText")]
+    for label in ("real", "predicted", "stdev real", "stdev pred"):
+        assert label in texts
+
+
+def test_live_series_buffer_until_backfill_lands():
+    """Ordering contract (js/index.js:55-66): live Series frames arriving
+    while the history fetch is in flight are buffered and applied AFTER the
+    backfill, so the chart is chronological."""
+    h, deferred = dashboard(defer_series=True)
+    h.ws.server_open()
+    # live frame arrives BEFORE the backfill response
+    h.ws.server_message(frame(
+        jsonClass="Series", real=[999], pred=[998], realStddev=1, predStddev=1,
+    ))
+    ctx = h.el("livechart").ctx
+    ctx.calls.clear()
+    # backfill resolves with history; then the pending live frame flushes
+    deferred.resolve([
+        {"jsonClass": "Series", "real": [1, 2], "pred": [1, 2],
+         "realStddev": 0, "predStddev": 0},
+    ])
+    # chart drew at least twice (backfill push + flushed live push)
+    assert len(ctx.ops("clearRect")) >= 2
+    # a later live frame now applies immediately
+    ctx.calls.clear()
+    h.ws.server_message(frame(
+        jsonClass="Series", real=[5], pred=[6], realStddev=0, predStddev=0,
+    ))
+    assert len(ctx.ops("clearRect")) == 1
+
+
+def test_post_rides_websocket_when_open_else_http():
+    h = dashboard()
+    h.ws.server_open()
+    h.interp.run("api.postStats(1, 2, 3, 4, 5);")
+    h.interp.run_jobs()
+    assert len(h.ws.sent) == 1
+    sent = json.loads(h.ws.sent[0])
+    assert sent == {"jsonClass": "Stats", "count": 1, "batch": 2, "mse": 3,
+                    "realStddev": 4, "predStddev": 5}
+    # close the socket: posts fall back to HTTP (reference api.js:65-79)
+    h.fetch_routes["/api"] = {"status": "OK"}
+    h.ws.server_close()
+    before = len(h.fetches)
+    h.interp.run("api.postConfig('id-1', 'http://h', ['7']);")
+    h.interp.run_jobs()
+    url, opts = h.fetches[before]
+    assert url == "/api"
+    assert opts.get("method") == "POST"
+    assert json.loads(opts.get("body")) == {
+        "jsonClass": "Config", "id": "id-1", "host": "http://h", "viz": ["7"],
+    }
+
+
+def test_reconnect_after_close_via_timer():
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_close()
+    assert len(h.timers) == 1  # the 5s reconnect
+    h.run_timers()
+    assert len(h.websockets) == 2  # a fresh socket was opened
+
+
+def test_websocket_off_suppresses_reconnect():
+    h = dashboard()
+    h.ws.server_open()
+    h.interp.run("api.websocketOff();")
+    h.interp.run_jobs()
+    assert not h.timers  # deliberate close: no reconnect scheduled
+
+
+def test_guid_shape():
+    h = dashboard()
+    h.interp.run("window._g = api.guid();")
+    guid = h.interp.global_this.get("_g")
+    import re
+
+    assert re.fullmatch(
+        r"[0-9a-f]{8}-[0-9a-f]{4}-4[0-9a-f]{3}-[89ab][0-9a-f]{3}-[0-9a-f]{12}",
+        guid,
+    ), guid
+
+
+def test_bad_frame_does_not_kill_the_dispatcher():
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message("this is not json")
+    h.ws.server_message(frame(
+        jsonClass="Stats", count=7, batch=7, mse=7, realStddev=7, predStddev=7,
+    ))
+    assert h.el("count").text == "7"
+    assert any("error" in line for line in h.console)
+
+
+# ---------------------------------------------------------------------------
+# negative controls: the suite's sensitivity is itself tested — a broken
+# dispatch or a missing counter id must change observable behavior, so the
+# assertions above would fail on a real regression
+
+def test_negative_control_broken_dispatch_is_detected(tmp_path):
+    """A typo'd jsonClass case in index.js leaves the counters un-updated —
+    exactly what test_stats_frame_updates_all_five_counters asserts on."""
+    with open(js_path("index.js"), encoding="utf-8") as fh:
+        src = fh.read()
+    broken = src.replace('case "Stats":', 'case "Statz":')
+    assert broken != src, "mutation site vanished; update the control"
+    mutated = tmp_path / "index.js"
+    mutated.write_text(broken, encoding="utf-8")
+
+    h = Harness([os.path.join(ASSETS, "index.html")])
+    h.fetch_routes["/api/stats"] = {"jsonClass": "Stats", "count": 0, "batch": 0,
+                                    "mse": 0, "realStddev": 0, "predStddev": 0}
+    h.fetch_routes["/api/series"] = []
+    h.load_script(js_path("api.js"))
+    h.load_script(js_path("chart.js"))
+    h.load_script(str(mutated))
+    h.dom_content_loaded()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="Stats", count=42, batch=1, mse=1, realStddev=1, predStddev=1,
+    ))
+    assert h.el("count").text != "42"  # the regression IS observable
+
+
+def test_negative_control_missing_counter_id_is_detected():
+    """Removing a counter element (as a renamed id in index.html would)
+    makes the Stats handler throw — the dispatcher logs it and the counter
+    never updates, so the positive tests would fail."""
+    h = dashboard()
+    h.ws.server_open()
+    del h.elements["mse"]  # simulate id="mse" missing from index.html
+    h.ws.server_message(frame(
+        jsonClass="Stats", count=42, batch=1, mse=7, realStddev=9, predStddev=9,
+    ))
+    # the handler throws at the missing element: counters after it in the
+    # update order never change — test_stats_frame_updates_all_five_counters
+    # would fail on exactly this
+    assert h.el("realStddev").text != "9"
+    assert h.el("predStddev").text != "9"
+    assert any("error" in line for line in h.console)
+
+
+def test_negative_control_syntax_error_is_detected(tmp_path):
+    """The lint catches a syntax break (the sbt-jshint analog)."""
+    with open(js_path("api.js"), encoding="utf-8") as fh:
+        src = fh.read()
+    mutated = tmp_path / "api.js"
+    mutated.write_text(src.replace("this.ws.send(text);",
+                                   "this.ws.send(text"), encoding="utf-8")
+    with pytest.raises(Exception):
+        with open(mutated, encoding="utf-8") as fh:
+            parse(fh.read())
+
+
+# ---------------------------------------------------------------------------
+# manual test harness page (test.html + api.js + test.js)
+
+def harness_page():
+    h = Harness([os.path.join(ASSETS, "test.html")])
+    h.fetch_routes["/api"] = {"status": "OK"}
+    for name in ("api.js", "test.js"):
+        h.load_script(js_path(name))
+    h.dom_content_loaded()
+    return h
+
+
+def test_harness_ws_toggle_and_log():
+    h = harness_page()
+    assert not h.websockets
+    h.click("wsToggle")
+    assert len(h.websockets) == 1
+    assert h.el("wsToggle").text == "websocket: on"
+    h.ws.server_open()
+    h.ws.server_message(frame(jsonClass="Stats", count=1, batch=1, mse=1,
+                              realStddev=1, predStddev=1))
+    # the received frame was logged into the table (time cell + json cell);
+    # rows also hold the _Socket open event — find the Stats row
+    log_rows = h.el("log").rows
+    assert log_rows, "no rows logged"
+    assert any(
+        len(r.rows) >= 2 and "Stats" in r.rows[1].text for r in log_rows
+    ), [r.rows[1].text for r in log_rows if len(r.rows) >= 2]
+    h.click("wsToggle")
+    assert h.el("wsToggle").text == "websocket: off"
+
+
+def test_harness_post_config_reads_form_fields():
+    h = harness_page()
+    h.el("cfgId").set("value", "abc")
+    h.el("cfgHost").set("value", "http://lgn")
+    h.el("cfgViz").set("value", " 1, 2 ,3")
+    h.click("postConfig")
+    url, opts = h.fetches[-1]
+    assert url == "/api"
+    body = json.loads(opts.get("body"))
+    assert body == {"jsonClass": "Config", "id": "abc", "host": "http://lgn",
+                    "viz": ["1", "2", "3"]}  # split(",").map(trim)
+
+
+def test_harness_post_stats_numbers():
+    h = harness_page()
+    for el_id, value in (("stCount", "10"), ("stBatch", "2"), ("stMse", "30"),
+                         ("stReal", "4"), ("stPred", "5")):
+        h.el(el_id).set("value", value)
+    h.click("postStats")
+    body = json.loads(h.fetches[-1][1].get("body"))
+    assert body == {"jsonClass": "Stats", "count": 10, "batch": 2, "mse": 30,
+                    "realStddev": 4, "predStddev": 5}

@@ -1,0 +1,347 @@
+"""jsmini browser environment: the DOM/net/timer stubs the dashboard uses.
+
+Gives tools/jsmini.py enough browser to run the REAL shipped assets
+(web/assets/js/*.js) in CI: a document whose elements are materialized from
+the ``id="..."`` attributes of the REAL page HTML (so a counter id missing
+from index.html fails the test, exactly like a browser), createElement /
+appendChild / replaceChildren / textContent / classList, table insertRow /
+insertCell (test.html's log), a 2d-canvas context that records draw calls,
+controllable WebSocket and fetch stubs, setTimeout on a virtual clock, and
+``window`` as the global object (bare ``api`` resolves through it, like a
+browser global).
+"""
+
+from __future__ import annotations
+
+import re as _re
+
+try:
+    from .jsmini import (
+        Interp, JSObject, JSThrow, js_number, js_string, js_truthy, undefined,
+    )
+    from .jsstdlib import MiniPromise, install_globals, promise_resolved
+except ImportError:  # script import
+    from jsmini import (  # type: ignore
+        Interp, JSObject, JSThrow, js_number, js_string, js_truthy, undefined,
+    )
+    from jsstdlib import (  # type: ignore
+        MiniPromise, install_globals, promise_resolved,
+    )
+
+
+def _arg(args, i, default=undefined):
+    return args[i] if i < len(args) else default
+
+
+class Element(JSObject):
+    def __init__(self, harness, tag: str, el_id: str = ""):
+        super().__init__()
+        self.harness = harness
+        self.tag = tag.lower()
+        self.el_id = el_id
+        self.children: list[Element] = []
+        self.listeners: dict[str, list] = {}
+        self.class_set: set[str] = set()
+        self.rows: list[Element] = []  # table rows / row cells
+        self.set("textContent", "")
+        self.set("value", "")
+        self.set("src", "")
+        self.set("title", "")
+        if self.tag == "canvas":
+            self.set("clientWidth", 800.0)
+            self.set("clientHeight", 360.0)
+            self.set("width", 0.0)
+            self.set("height", 0.0)
+            self.ctx = CanvasContext()
+        self._install_methods()
+
+    def _install_methods(self):
+        self.set("appendChild", lambda this, args: self._append(_arg(args, 0)))
+        self.set("replaceChildren", lambda this, args: self._replace(list(args)))
+        self.set("addEventListener", lambda this, args: self._listen(
+            js_string(_arg(args, 0)), _arg(args, 1)
+        ))
+        self.set("insertRow", lambda this, args: self._insert_row(
+            int(js_number(_arg(args, 0, 0.0)))
+        ))
+        self.set("insertCell", lambda this, args: self._insert_cell())
+        if self.tag == "canvas":
+            self.set("getContext", lambda this, args: self.ctx)
+        cl = JSObject({
+            "toggle": lambda this, args: self._class_toggle(args),
+            "add": lambda this, args: self.class_set.update(
+                {js_string(a) for a in args}
+            ) or undefined,
+            "remove": lambda this, args: [
+                self.class_set.discard(js_string(a)) for a in args
+            ] and undefined or undefined,
+            "contains": lambda this, args: js_string(_arg(args, 0)) in self.class_set,
+        })
+        self.set("classList", cl)
+
+    def _append(self, child):
+        self.children.append(child)
+        return child
+
+    def _replace(self, new_children):
+        self.children = list(new_children)
+        return undefined
+
+    def _listen(self, event, fn):
+        self.listeners.setdefault(event, []).append(fn)
+        return undefined
+
+    def _insert_row(self, index):
+        row = Element(self.harness, "tr")
+        self.rows.insert(min(index, len(self.rows)), row)
+        return row
+
+    def _insert_cell(self):
+        cell = Element(self.harness, "td")
+        self.rows.append(cell)  # a row's cells live in its rows list
+        return cell
+
+    def _class_toggle(self, args):
+        name = js_string(_arg(args, 0))
+        if len(args) >= 2:
+            force = js_truthy(args[1])  # JS coercion, not Python truthiness
+            (self.class_set.add if force else self.class_set.discard)(name)
+            return force
+        if name in self.class_set:
+            self.class_set.discard(name)
+            return False
+        self.class_set.add(name)
+        return True
+
+    # convenience for tests
+    @property
+    def text(self) -> str:
+        return js_string(self.get("textContent"))
+
+    def fire(self, interp: Interp, event: str, event_obj=None):
+        ev = event_obj or JSObject({"target": self, "type": event})
+        for fn in list(self.listeners.get(event, [])):
+            interp.invoke(fn, undefined, [ev])
+
+
+class CanvasContext(JSObject):
+    """Records every draw call so tests can assert the chart actually drew."""
+
+    def __init__(self):
+        super().__init__()
+        self.calls: list[tuple] = []
+        for m in ("clearRect", "beginPath", "moveTo", "lineTo", "stroke",
+                  "fillRect", "fillText"):
+            self.set(m, self._recorder(m))
+        self.set("measureText", lambda this, args: JSObject({"width": 40.0}))
+
+    def _recorder(self, m):
+        def record(this, args):
+            self.calls.append((m, tuple(js_string(a) if isinstance(a, str)
+                                        else a for a in args)))
+            return undefined
+        return record
+
+    def ops(self, name=None):
+        return [c for c in self.calls if name is None or c[0] == name]
+
+
+class FakeWebSocket(JSObject):
+    CONNECTING, OPEN, CLOSING, CLOSED = 0.0, 1.0, 2.0, 3.0
+
+    def __init__(self, harness, url):
+        super().__init__()
+        self.harness = harness
+        self.url = url
+        self.sent: list[str] = []
+        self.set("readyState", self.CONNECTING)
+        self.set("send", lambda this, args: self.sent.append(
+            js_string(_arg(args, 0))
+        ) or undefined)
+        self.set("close", lambda this, args: self.server_close())
+        harness.websockets.append(self)
+
+    def server_open(self):
+        self.set("readyState", self.OPEN)
+        self._emit("onopen")
+
+    def server_close(self):
+        self.set("readyState", self.CLOSED)
+        self._emit("onclose")
+        return undefined
+
+    def server_message(self, text: str):
+        ev = JSObject({"data": text})
+        self._emit("onmessage", ev)
+
+    def _emit(self, name, ev=None):
+        fn = self.get(name)
+        if fn is not undefined:
+            self.harness.interp.invoke(
+                fn, undefined, [ev or JSObject()]
+            )
+        self.harness.interp.run_jobs()
+
+
+class Harness:
+    """Load the real assets, provide the browser, drive events from tests."""
+
+    def __init__(self, html_paths: list[str], seed: int = 0):
+        self.interp = Interp()
+        self.console = install_globals(self.interp, rng_seed=seed)
+        self.elements: dict[str, Element] = {}
+        self.websockets: list[FakeWebSocket] = []
+        self.fetches: list[tuple[str, JSObject | None]] = []  # (url, opts)
+        self.fetch_routes: dict[str, object] = {}  # url -> python value/callable
+        self.timers: list[tuple[float, object]] = []
+        self._timer_id = 0
+        self.doc_listeners: dict[str, list] = {}
+
+        window = self.interp.global_this
+        env = self.interp.global_env
+        env.declare("window", window)
+        env.declare("globalThis", window)
+
+        for path in html_paths:
+            with open(path, encoding="utf-8") as fh:
+                html = fh.read()
+            for tag, el_id in _re.findall(
+                r"<(\w+)[^>]*?\bid=\"([^\"]+)\"", html
+            ):
+                self.elements[el_id] = Element(self, tag, el_id)
+
+        document = JSObject({
+            "getElementById": lambda this, args: self.elements.get(
+                js_string(_arg(args, 0)), None
+            ),
+            "createElement": lambda this, args: Element(
+                self, js_string(_arg(args, 0))
+            ),
+            "addEventListener": lambda this, args: self.doc_listeners.setdefault(
+                js_string(_arg(args, 0)), []
+            ).append(_arg(args, 1)) or undefined,
+        })
+        window.set("document", document)
+        env.declare("document", document)
+
+        location = JSObject({"protocol": "http:", "host": "localhost:8888"})
+        window.set("location", location)
+        env.declare("location", location)
+
+        class WSCtor(JSObject):
+            def __call__(ws_self, this, args):  # noqa: N805
+                return FakeWebSocket(self, js_string(_arg(args, 0)))
+
+        ws_ctor = WSCtor({
+            "CONNECTING": 0.0, "OPEN": 1.0, "CLOSING": 2.0, "CLOSED": 3.0,
+        })
+        window.set("WebSocket", ws_ctor)
+        env.declare("WebSocket", ws_ctor)
+
+        def fetch(this, args):
+            url = js_string(_arg(args, 0))
+            opts = _arg(args, 1, None)
+            self.fetches.append((url, opts if isinstance(opts, JSObject) else None))
+            route = self.fetch_routes.get(url)
+            if route is None:
+                p = MiniPromise(self.interp)
+                p._settle("rejected", "TypeError: fetch failed: " + url)
+                return p
+            if isinstance(route, DeferredRoute):
+                return route.promise
+            body = route() if callable(route) else route
+            return promise_resolved(self.interp, self._response(body))
+
+        window.set("fetch", fetch)
+        env.declare("fetch", fetch)
+
+        def set_timeout(this, args):
+            fn = _arg(args, 0)
+            delay = js_number(_arg(args, 1, 0.0))
+            self._timer_id += 1
+            self.timers.append((delay, fn, float(self._timer_id)))
+            return float(self._timer_id)
+
+        def clear_timeout(this, args):
+            tid = js_number(_arg(args, 0, -1.0))
+            self.timers = [t for t in self.timers if t[2] != tid]
+            return undefined
+
+        env.declare("setTimeout", set_timeout)
+        env.declare("clearTimeout", clear_timeout)
+        window.set("setTimeout", set_timeout)
+
+    # -- fetch plumbing -----------------------------------------------------
+
+    def _response(self, body) -> JSObject:
+        return JSObject({
+            "ok": True,
+            "status": 200.0,
+            "json": lambda t, a: promise_resolved(self.interp, _py_to_js(body)),
+            "text": lambda t, a: promise_resolved(
+                self.interp, js_string(_py_to_js(body))
+            ),
+        })
+
+    def defer(self, url: str) -> "DeferredRoute":
+        """Register a route whose response the TEST resolves later — lets a
+        test interleave websocket frames with an in-flight fetch (the
+        Series-backfill ordering contract)."""
+        route = DeferredRoute(self)
+        self.fetch_routes[url] = route
+        return route
+
+    # -- loading ------------------------------------------------------------
+
+    def load_script(self, path: str):
+        with open(path, encoding="utf-8") as fh:
+            self.interp.run(fh.read())
+        self.interp.run_jobs()
+
+    # -- event drivers ------------------------------------------------------
+
+    def dom_content_loaded(self):
+        for fn in self.doc_listeners.get("DOMContentLoaded", []):
+            self.interp.invoke(fn, undefined, [JSObject()])
+        self.interp.run_jobs()
+
+    def click(self, el_id: str):
+        self.elements[el_id].fire(self.interp, "click")
+        self.interp.run_jobs()
+
+    def run_timers(self):
+        """Fire every pending timer once (the 5s reconnect etc.)."""
+        due, self.timers = self.timers, []
+        for _delay, fn, _tid in due:
+            self.interp.invoke(fn, undefined, [])
+        self.interp.run_jobs()
+
+    @property
+    def ws(self) -> FakeWebSocket:
+        return self.websockets[-1]
+
+    def el(self, el_id: str) -> Element:
+        return self.elements[el_id]
+
+
+class DeferredRoute:
+    def __init__(self, harness: Harness):
+        self.harness = harness
+        self.promise = MiniPromise(harness.interp)
+
+    def resolve(self, body):
+        self.promise._settle("fulfilled", self.harness._response(body))
+        self.harness.interp.run_jobs()
+
+    def reject(self, reason="fetch failed"):
+        self.promise._settle("rejected", reason)
+        self.harness.interp.run_jobs()
+
+
+def _py_to_js(v):
+    try:
+        from .jsstdlib import _from_python
+    except ImportError:
+        from jsstdlib import _from_python  # type: ignore
+
+    return _from_python(v)

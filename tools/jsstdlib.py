@@ -1,0 +1,661 @@
+"""jsmini standard library: member dispatch + global builtins.
+
+Implements the JavaScript built-ins the dashboard assets use (see
+tools/jsmini.py for scope/why): String/Array/Number methods, JSON, Math,
+Promise (reactions run on the interpreter's job queue — the harness drains
+it between events, standing in for the browser's microtask checkpoint),
+Date, console, and the callable wrappers Number()/String()/Boolean().
+Host functions follow the (this, args) -> value convention.
+"""
+
+from __future__ import annotations
+
+import json as _json
+import math as _math
+import random as _random
+import re as _re
+import time as _time
+
+try:
+    from .jsmini import (
+        Interp,
+        JSFunction,
+        JSObject,
+        JSRegex,
+        JSThrow,
+        js_number,
+        js_string,
+        js_truthy,
+        strict_equals,
+        undefined,
+    )
+except ImportError:  # script import
+    from jsmini import (  # type: ignore
+        Interp,
+        JSFunction,
+        JSObject,
+        JSRegex,
+        JSThrow,
+        js_number,
+        js_string,
+        js_truthy,
+        strict_equals,
+        undefined,
+    )
+
+
+def _arg(args, i, default=undefined):
+    return args[i] if i < len(args) else default
+
+
+# ---------------------------------------------------------------------------
+# Promise — resolutions run as interpreter jobs
+
+class MiniPromise:
+    def __init__(self, interp: Interp):
+        self.interp = interp
+        self.state = "pending"
+        self.value = undefined
+        self.reactions: list = []  # (on_ok, on_err, next_promise)
+
+    # -- internal ----------------------------------------------------------
+
+    def _settle(self, state, value):
+        if self.state != "pending":
+            return
+        if state == "fulfilled" and isinstance(value, MiniPromise):
+            value._chain_into(self)
+            return
+        self.state = state
+        self.value = value
+        for reaction in self.reactions:
+            self._schedule(reaction)
+        self.reactions = []
+
+    def _chain_into(self, outer: "MiniPromise"):
+        self.then_callbacks(
+            lambda v: outer._settle("fulfilled", v),
+            lambda e: outer._settle("rejected", e),
+        )
+
+    def then_callbacks(self, ok, err):
+        nxt = MiniPromise(self.interp)
+        reaction = (ok, err, nxt)
+        if self.state == "pending":
+            self.reactions.append(reaction)
+        else:
+            self._schedule(reaction)
+        return nxt
+
+    def _schedule(self, reaction):
+        ok, err, nxt = reaction
+        state, value = self.state, self.value
+
+        def job():
+            try:
+                if state == "fulfilled":
+                    result = ok(value) if ok else value
+                    nxt._settle("fulfilled", result)
+                else:
+                    if err:
+                        nxt._settle("fulfilled", err(value))
+                    else:
+                        nxt._settle("rejected", value)
+            except JSThrow as exc:
+                nxt._settle("rejected", exc.value)
+
+        self.interp.enqueue_job(job)
+
+    # -- JS-facing methods -------------------------------------------------
+
+    def js_then(self, this, args):
+        on_ok = _arg(args, 0, None)
+        on_err = _arg(args, 1, None)
+
+        def wrap(fn):
+            if fn is None or fn is undefined:
+                return None
+            return lambda v: self.interp.invoke(fn, undefined, [v])
+
+        return self.then_callbacks(wrap(on_ok), wrap(on_err))
+
+    def js_catch(self, this, args):
+        return self.js_then(this, [undefined, _arg(args, 0, None)])
+
+    def js_finally(self, this, args):
+        fn = _arg(args, 0, None)
+
+        def run(v):
+            if fn is not None and fn is not undefined:
+                self.interp.invoke(fn, undefined, [])
+            return v
+
+        def run_err(e):
+            if fn is not None and fn is not undefined:
+                self.interp.invoke(fn, undefined, [])
+            raise JSThrow(e)
+
+        return self.then_callbacks(run, run_err)
+
+
+def promise_resolved(interp, value) -> MiniPromise:
+    p = MiniPromise(interp)
+    p._settle("fulfilled", value)
+    return p
+
+
+def promise_rejected(interp, value) -> MiniPromise:
+    p = MiniPromise(interp)
+    p._settle("rejected", value)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# member dispatch
+
+def get_member(interp: Interp, obj, name):
+    if isinstance(obj, JSObject):
+        value = obj.get(name)
+        if value is not undefined:
+            return value
+        if name == "hasOwnProperty":
+            return lambda this, args: js_string(_arg(args, 0)) in obj.props
+        return undefined
+    if isinstance(obj, list):
+        return _array_member(interp, obj, name)
+    if isinstance(obj, str):
+        return _string_member(interp, obj, name)
+    if isinstance(obj, float):
+        return _number_member(interp, obj, name)
+    if isinstance(obj, bool):
+        return _number_member(interp, js_number(obj), name)
+    if isinstance(obj, MiniPromise):
+        return {
+            "then": obj.js_then, "catch": obj.js_catch, "finally": obj.js_finally,
+        }.get(name, undefined)
+    if isinstance(obj, JSFunction):
+        if name == "prototype":
+            return obj.prototype
+        if name == "name":
+            return obj.name
+        if name == "call":
+            return lambda this, args: obj.call(_arg(args, 0), list(args[1:]))
+        if name == "apply":
+            return lambda this, args: obj.call(
+                _arg(args, 0), list(_arg(args, 1, []) or [])
+            )
+        if name == "bind":
+            def bind(this, args):
+                b_this = _arg(args, 0)
+                pre = list(args[1:])
+                return lambda t2, a2: obj.call(b_this, pre + list(a2))
+
+            return bind
+        custom = getattr(obj, "js_" + name, None)
+        if custom is not None:
+            return custom
+        return undefined
+    if isinstance(obj, JSRegex):
+        return {
+            "source": obj.source, "flags": obj.flags,
+            "test": lambda this, args: bool(
+                obj.pattern.search(js_string(_arg(args, 0)))
+            ),
+        }.get(name, undefined)
+    if callable(obj):  # host function: no members the assets need
+        return undefined
+    if obj is undefined or obj is None:
+        raise JSThrow(
+            f"TypeError: cannot read properties of {js_string(obj)} "
+            f"(reading '{name}')"
+        )
+    return undefined
+
+
+def _array_member(interp: Interp, arr: list, name):
+    if name == "length":
+        return float(len(arr))
+
+    def method(fn):
+        return fn
+
+    if name == "push":
+        def push(this, args):
+            arr.extend(args)
+            return float(len(arr))
+        return push
+    if name == "pop":
+        return lambda this, args: arr.pop() if arr else undefined
+    if name == "shift":
+        return lambda this, args: arr.pop(0) if arr else undefined
+    if name == "unshift":
+        def unshift(this, args):
+            arr[0:0] = list(args)
+            return float(len(arr))
+        return unshift
+    if name == "splice":
+        def splice(this, args):
+            start = int(js_number(_arg(args, 0, 0.0)))
+            if start < 0:
+                start = max(len(arr) + start, 0)
+            count = (
+                len(arr) - start
+                if len(args) < 2
+                else max(int(js_number(args[1])), 0)
+            )
+            removed = arr[start : start + count]
+            arr[start : start + count] = list(args[2:])
+            return removed
+        return splice
+    if name == "slice":
+        def slice_(this, args):
+            start = int(js_number(_arg(args, 0, 0.0)))
+            end = len(arr) if len(args) < 2 else int(js_number(args[1]))
+            return arr[slice(start, end)]
+        return slice_
+    if name == "concat":
+        def concat(this, args):
+            out = list(arr)
+            for a in args:
+                if isinstance(a, list):
+                    out.extend(a)
+                else:
+                    out.append(a)
+            return out
+        return concat
+    if name == "join":
+        def join(this, args):
+            sep = js_string(_arg(args, 0, ","))
+            return sep.join(
+                "" if x is undefined or x is None else js_string(x) for x in arr
+            )
+        return join
+    if name == "indexOf":
+        def index_of(this, args):
+            target = _arg(args, 0)
+            for i, x in enumerate(arr):
+                if strict_equals(x, target):
+                    return float(i)
+            return -1.0
+        return index_of
+    if name == "includes":
+        def includes(this, args):
+            target = _arg(args, 0)
+            return any(strict_equals(x, target) for x in arr)
+        return includes
+    if name == "forEach":
+        def for_each(this, args):
+            fn = args[0]
+            for i, x in enumerate(list(arr)):
+                interp.invoke(fn, undefined, [x, float(i), arr])
+            return undefined
+        return for_each
+    if name == "map":
+        def map_(this, args):
+            fn = args[0]
+            return [
+                interp.invoke(fn, undefined, [x, float(i), arr])
+                for i, x in enumerate(list(arr))
+            ]
+        return map_
+    if name == "filter":
+        def filter_(this, args):
+            fn = args[0]
+            return [
+                x for i, x in enumerate(list(arr))
+                if js_truthy(interp.invoke(fn, undefined, [x, float(i), arr]))
+            ]
+        return filter_
+    if name == "find":
+        def find(this, args):
+            fn = args[0]
+            for i, x in enumerate(list(arr)):
+                if js_truthy(interp.invoke(fn, undefined, [x, float(i), arr])):
+                    return x
+            return undefined
+        return find
+    if name == "some":
+        def some(this, args):
+            fn = args[0]
+            return any(
+                js_truthy(interp.invoke(fn, undefined, [x, float(i), arr]))
+                for i, x in enumerate(list(arr))
+            )
+        return some
+    if name == "every":
+        def every(this, args):
+            fn = args[0]
+            return all(
+                js_truthy(interp.invoke(fn, undefined, [x, float(i), arr]))
+                for i, x in enumerate(list(arr))
+            )
+        return every
+    if name == "reduce":
+        def reduce_(this, args):
+            fn = args[0]
+            items = list(arr)
+            if len(args) >= 2:
+                acc = args[1]
+                start = 0
+            else:
+                acc = items[0]
+                start = 1
+            for i in range(start, len(items)):
+                acc = interp.invoke(fn, undefined, [acc, items[i], float(i), arr])
+            return acc
+        return reduce_
+    if name == "reverse":
+        def reverse(this, args):
+            arr.reverse()
+            return arr
+        return reverse
+    if name == "sort":
+        def sort(this, args):
+            import functools
+
+            if args and args[0] is not undefined:
+                fn = args[0]
+                arr.sort(key=functools.cmp_to_key(
+                    lambda a, b: (lambda r: -1 if r < 0 else (1 if r > 0 else 0))(
+                        js_number(interp.invoke(fn, undefined, [a, b]))
+                    )
+                ))
+            else:
+                arr.sort(key=js_string)
+            return arr
+        return sort
+    if name == "toString":
+        return lambda this, args: js_string(arr)
+    return undefined
+
+
+def _string_member(interp: Interp, s: str, name):
+    if name == "length":
+        return float(len(s))
+    if name == "replace":
+        def replace(this, args):
+            pat, repl = _arg(args, 0), _arg(args, 1)
+
+            def do_one(match_text):
+                if isinstance(repl, JSFunction) or callable(repl):
+                    return js_string(interp.invoke(repl, undefined, [match_text]))
+                return js_string(repl)
+
+            if isinstance(pat, JSRegex):
+                count = 0 if pat.global_ else 1
+                return pat.pattern.sub(lambda m: do_one(m.group(0)), s, count=count)
+            target = js_string(pat)
+            if isinstance(repl, JSFunction) or callable(repl):
+                return s.replace(target, do_one(target), 1)
+            return s.replace(target, js_string(repl), 1)
+        return replace
+    if name == "split":
+        def split(this, args):
+            sep = _arg(args, 0)
+            if sep is undefined:
+                return [s]
+            sep_s = js_string(sep)
+            if sep_s == "":
+                return list(s)
+            return s.split(sep_s)
+        return split
+    simple = {
+        "trim": lambda this, args: s.strip(),
+        "toLowerCase": lambda this, args: s.lower(),
+        "toUpperCase": lambda this, args: s.upper(),
+        "toString": lambda this, args: s,
+        "charAt": lambda this, args: (
+            s[int(js_number(_arg(args, 0, 0.0)))]
+            if 0 <= int(js_number(_arg(args, 0, 0.0))) < len(s) else ""
+        ),
+        "charCodeAt": lambda this, args: (
+            float(ord(s[int(js_number(_arg(args, 0, 0.0)))]))
+            if 0 <= int(js_number(_arg(args, 0, 0.0))) < len(s)
+            else float("nan")
+        ),
+        "indexOf": lambda this, args: float(s.find(js_string(_arg(args, 0)))),
+        "includes": lambda this, args: js_string(_arg(args, 0)) in s,
+        "startsWith": lambda this, args: s.startswith(js_string(_arg(args, 0))),
+        "endsWith": lambda this, args: s.endswith(js_string(_arg(args, 0))),
+        "slice": lambda this, args: s[
+            slice(
+                int(js_number(_arg(args, 0, 0.0))),
+                None if len(args) < 2 else int(js_number(args[1])),
+            )
+        ],
+        "substring": lambda this, args: s[
+            max(int(js_number(_arg(args, 0, 0.0))), 0):
+            (len(s) if len(args) < 2 else max(int(js_number(args[1])), 0))
+        ],
+        "repeat": lambda this, args: s * int(js_number(_arg(args, 0, 0.0))),
+        "padStart": lambda this, args: s.rjust(
+            int(js_number(_arg(args, 0, 0.0))), js_string(_arg(args, 1, " "))
+        ),
+    }
+    return simple.get(name, undefined)
+
+
+def _number_member(interp: Interp, x: float, name):
+    if name == "toString":
+        def to_string(this, args):
+            if not args or args[0] is undefined:
+                return js_string(x)
+            radix = int(js_number(args[0]))
+            n = int(x)
+            if n == 0:
+                return "0"
+            digits = "0123456789abcdefghijklmnopqrstuvwxyz"
+            neg, n = n < 0, abs(n)
+            out = []
+            while n:
+                out.append(digits[n % radix])
+                n //= radix
+            return ("-" if neg else "") + "".join(reversed(out))
+        return to_string
+    if name == "toLocaleString":
+        def to_locale(this, args):
+            if x.is_integer():
+                return f"{int(x):,}"
+            return f"{x:,.3f}"
+        return to_locale
+    if name == "toFixed":
+        return lambda this, args: f"{x:.{int(js_number(_arg(args, 0, 0.0)))}f}"
+    return undefined
+
+
+# ---------------------------------------------------------------------------
+# globals
+
+def install_globals(interp: Interp, rng_seed: int = 0):
+    """Declare the engine-level builtins (no DOM — tools/jsdom.py adds the
+    browser environment on top)."""
+    env = interp.global_env
+    rng = _random.Random(rng_seed)
+
+    math_obj = JSObject({
+        "random": lambda this, args: rng.random(),
+        "floor": lambda this, args: float(_math.floor(js_number(_arg(args, 0)))),
+        "ceil": lambda this, args: float(_math.ceil(js_number(_arg(args, 0)))),
+        "round": lambda this, args: float(_math.floor(js_number(_arg(args, 0)) + 0.5)),
+        "abs": lambda this, args: abs(js_number(_arg(args, 0))),
+        "sqrt": lambda this, args: _math.sqrt(js_number(_arg(args, 0))),
+        "pow": lambda this, args: js_number(_arg(args, 0)) ** js_number(_arg(args, 1)),
+        "min": lambda this, args: (
+            min((js_number(a) for a in args), default=float("inf"))
+        ),
+        "max": lambda this, args: (
+            max((js_number(a) for a in args), default=float("-inf"))
+        ),
+        "PI": _math.pi,
+    })
+    env.declare("Math", math_obj)
+
+    def json_stringify(this, args):
+        return _json.dumps(_to_python(_arg(args, 0)), separators=(",", ":"))
+
+    def json_parse(this, args):
+        try:
+            return _from_python(_json.loads(js_string(_arg(args, 0))))
+        except Exception:
+            raise JSThrow("SyntaxError: Unexpected token in JSON")
+
+    env.declare("JSON", JSObject({
+        "stringify": json_stringify, "parse": json_parse,
+    }))
+
+    def number_call(this, args):
+        return js_number(_arg(args, 0, 0.0))
+
+    number_obj = JSObject({
+        "isInteger": lambda this, args: isinstance(_arg(args, 0), float)
+        and float(_arg(args, 0)).is_integer(),
+        "isFinite": lambda this, args: isinstance(_arg(args, 0), float)
+        and _math.isfinite(_arg(args, 0)),
+        "parseFloat": lambda this, args: js_number(_arg(args, 0)),
+        "MAX_SAFE_INTEGER": float(2**53 - 1),
+    })
+
+    class CallableNumber(JSObject):
+        def __call__(self, this, args):
+            return number_call(this, args)
+
+    num = CallableNumber(number_obj.props)
+    env.declare("Number", num)
+    env.declare("String", lambda this, args: js_string(_arg(args, 0, "")))
+    env.declare("Boolean", lambda this, args: js_truthy(_arg(args, 0)))
+    env.declare("parseInt", lambda this, args: _parse_int(args))
+    env.declare("parseFloat", lambda this, args: js_number(_arg(args, 0)))
+    env.declare("isNaN", lambda this, args: _math.isnan(js_number(_arg(args, 0))))
+    env.declare("NaN", float("nan"))
+    env.declare("Infinity", float("inf"))
+
+    class CallableArray(JSObject):
+        def __call__(self, this, args):  # Array(n) / Array(a, b, c)
+            if len(args) == 1 and isinstance(args[0], float):
+                return [undefined] * int(args[0])
+            return list(args)
+
+    env.declare("Array", CallableArray({
+        "isArray": lambda this, args: isinstance(_arg(args, 0), list),
+        "from": lambda this, args: list(_arg(args, 0, []) or []),
+    }))
+
+    env.declare("Object", JSObject({
+        "keys": lambda this, args: list(_arg(args, 0).props)
+        if isinstance(_arg(args, 0), JSObject) else [],
+        "values": lambda this, args: list(_arg(args, 0).props.values())
+        if isinstance(_arg(args, 0), JSObject) else [],
+        "assign": lambda this, args: _object_assign(args),
+        "entries": lambda this, args: [
+            [k, v] for k, v in _arg(args, 0).props.items()
+        ] if isinstance(_arg(args, 0), JSObject) else [],
+    }))
+
+    def promise_ctor(this, args):
+        p = MiniPromise(interp)
+        executor = _arg(args, 0)
+        resolve = lambda t, a: p._settle("fulfilled", _arg(a, 0))  # noqa: E731
+        reject = lambda t, a: p._settle("rejected", _arg(a, 0))  # noqa: E731
+        interp.invoke(executor, undefined, [resolve, reject])
+        return p
+
+    class CallablePromise(JSObject):
+        def __call__(self, this, args):
+            return promise_ctor(this, args)
+
+    env.declare("Promise", CallablePromise({
+        "resolve": lambda this, args: promise_resolved(interp, _arg(args, 0)),
+        "reject": lambda this, args: promise_rejected(interp, _arg(args, 0)),
+    }))
+
+    def date_ctor(this, args):
+        obj = JSObject({
+            "_ms": float(_time.time() * 1000) if not args else js_number(args[0]),
+        })
+        obj.set("getTime", lambda t, a: obj.get("_ms"))
+        obj.set(
+            "toLocaleTimeString",
+            lambda t, a: _time.strftime(
+                "%H:%M:%S", _time.localtime(obj.get("_ms") / 1000.0)
+            ),
+        )
+        obj.set("toISOString", lambda t, a: _time.strftime(
+            "%Y-%m-%dT%H:%M:%S", _time.gmtime(obj.get("_ms") / 1000.0)
+        ))
+        return obj
+
+    class CallableDate(JSObject):
+        def __call__(self, this, args):
+            return date_ctor(this, args)
+
+    env.declare("Date", CallableDate({
+        "now": lambda this, args: float(_time.time() * 1000),
+    }))
+
+    console_log: list[str] = []
+
+    def log_fn(level):
+        def log(this, args):
+            console_log.append(level + ": " + " ".join(js_string(a) for a in args))
+            return undefined
+        return log
+
+    env.declare("console", JSObject({
+        "log": log_fn("log"), "error": log_fn("error"),
+        "warn": log_fn("warn"), "info": log_fn("info"),
+    }))
+    return console_log
+
+
+def _parse_int(args):
+    s = js_string(_arg(args, 0)).strip()
+    radix = int(js_number(_arg(args, 1, 10.0)))
+    m = _re.match(r"[+-]?[0-9a-zA-Z]+", s)
+    if not m:
+        return float("nan")
+    try:
+        return float(int(m.group(0), radix))
+    except ValueError:
+        # parse the longest valid prefix
+        text = m.group(0)
+        for end in range(len(text), 0, -1):
+            try:
+                return float(int(text[:end], radix))
+            except ValueError:
+                continue
+        return float("nan")
+
+
+def _object_assign(args):
+    target = _arg(args, 0)
+    for src in args[1:]:
+        if isinstance(src, JSObject):
+            target.props.update(src.props)
+    return target
+
+
+def _to_python(v):
+    if v is undefined:
+        return None
+    if isinstance(v, JSObject):
+        return {k: _to_python(x) for k, x in v.props.items()
+                if not (isinstance(x, JSFunction) or callable(x) or x is undefined)}
+    if isinstance(v, list):
+        return [_to_python(x) for x in v]
+    if isinstance(v, float) and v.is_integer() and abs(v) < 2**53:
+        return int(v)
+    return v
+
+
+def _from_python(v):
+    if v is None:
+        return None
+    if isinstance(v, dict):
+        return JSObject({k: _from_python(x) for k, x in v.items()})
+    if isinstance(v, list):
+        return [_from_python(x) for x in v]
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return float(v)
+    return v

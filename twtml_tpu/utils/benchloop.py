@@ -135,7 +135,9 @@ def measure_pipeline(
 
     warm = featurize(chunks[0])
     for _ in range(warmup_steps):
-        model.step(warm).mse.block_until_ready()
+        # completion fetch, not block_until_ready: warmup must fully drain
+        # before the first timed pass (module docstring)
+        float(model.step(warm).mse)
 
     def run_pass():
         if resettable:
