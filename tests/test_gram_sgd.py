@@ -1,0 +1,290 @@
+"""Differential tests: the Gram-domain (dual) sparse SGD loop (ops/gram.py)
+against the per-iteration gather/scatter formulation — the two are the same
+recursion in different bases, so multi-step weight trajectories must agree to
+float tolerance across every parity-critical semantic: √-decay step sizes,
+SquaredL2Updater pre-scale (including entries the batch never touches),
+Bernoulli mini-batch sampling, convergence freeze, zero-sample skip, and the
+logistic residual. ``gram_matrix`` itself is pinned against the dense
+densify-matmul reference, including the cond-gated two-plane split for
+counts > 255 and non-integral token values."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from twtml_tpu.features.batch import NUM_NUMBER_FEATURES, FeatureBatch, UnitBatch
+from twtml_tpu.models.logistic import StreamingLogisticRegressionWithSGD
+from twtml_tpu.models.sgd import make_sgd_train_step, zero_weights
+from twtml_tpu.ops.gram import fits_gram, gram_matrix
+from twtml_tpu.ops.sparse import densify_text
+
+F_TEXT = 512  # small enough for fast CPU tests; forced sparse via use_sparse
+
+
+def random_batch(rng, b=24, l=12, f_text=F_TEXT, label_scale=50.0):
+    token_idx = rng.integers(0, f_text, size=(b, l)).astype(np.int32)
+    token_val = rng.integers(1, 4, size=(b, l)).astype(np.float32)
+    # padded token slots: idx 0, val 0 (the batch contract)
+    token_val[:, l - 2 :] = 0.0
+    token_idx[:, l - 2 :] = 0
+    numeric = rng.normal(size=(b, NUM_NUMBER_FEATURES)).astype(np.float32) * 0.1
+    label = rng.uniform(0, label_scale, size=(b,)).astype(np.float32)
+    mask = np.ones((b,), np.float32)
+    mask[b - 3 :] = 0.0  # padding rows
+    token_val[b - 3 :] = 0.0
+    numeric[b - 3 :] = 0.0
+    label[b - 3 :] = 0.0
+    return FeatureBatch(token_idx, token_val, numeric, label, mask)
+
+
+def run_chain(step, batches, w0):
+    w = jnp.asarray(w0)
+    outs = []
+    for b in batches:
+        w, out = step(w, b)
+        outs.append(out)
+    return np.asarray(w), outs
+
+
+def both_paths(batches, w0, **kw):
+    kw.setdefault("num_text_features", F_TEXT)
+    kw.setdefault("use_sparse", True)
+    kw.setdefault("num_iterations", 25)
+    kw.setdefault("step_size", 0.05)
+    scatter = make_sgd_train_step(use_gram=False, **kw)
+    gram = make_sgd_train_step(use_gram=True, **kw)
+    w_s, out_s = run_chain(scatter, batches, w0)
+    w_g, out_g = run_chain(gram, batches, w0)
+    return (w_s, out_s), (w_g, out_g)
+
+
+def assert_trajectories_match(res_s, res_g, rtol=2e-4, atol=2e-4):
+    (w_s, out_s), (w_g, out_g) = res_s, res_g
+    scale = max(1.0, float(np.max(np.abs(w_s))))
+    np.testing.assert_allclose(w_g, w_s, rtol=rtol, atol=atol * scale)
+    for a, b in zip(out_s, out_g):
+        # predictions are pre-update in both paths — identical math
+        np.testing.assert_allclose(
+            np.asarray(b.predictions), np.asarray(a.predictions), rtol=1e-5, atol=1e-4
+        )
+        np.testing.assert_allclose(float(b.mse), float(a.mse), rtol=1e-4, atol=1e-3)
+
+
+def test_gram_matrix_matches_dense_reference():
+    rng = np.random.default_rng(0)
+    batch = random_batch(rng)
+    dense = np.asarray(
+        densify_text(jnp.asarray(batch.token_idx), jnp.asarray(batch.token_val), F_TEXT)
+    )
+    z = np.concatenate([dense, batch.numeric], axis=1)
+    ref = z @ z.T
+    got = np.asarray(
+        gram_matrix(
+            jnp.asarray(batch.token_idx),
+            jnp.asarray(batch.token_val),
+            jnp.asarray(batch.numeric),
+            F_TEXT,
+        )
+    )
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-4)
+
+
+def test_gram_matrix_two_plane_split_counts_above_255():
+    rng = np.random.default_rng(1)
+    batch = random_batch(rng)
+    token_val = batch.token_val.copy()
+    token_idx = batch.token_idx.copy()
+    token_idx[0, :5] = 7  # duplicate feature occurrences...
+    token_val[0, :5] = 100.0  # ...summing to 500 > 255: bf16-inexact count
+    dense = np.asarray(densify_text(jnp.asarray(token_idx), jnp.asarray(token_val), F_TEXT))
+    z = np.concatenate([dense, batch.numeric], axis=1)
+    ref = z @ z.T
+    got = np.asarray(
+        gram_matrix(
+            jnp.asarray(token_idx),
+            jnp.asarray(token_val),
+            jnp.asarray(batch.numeric),
+            F_TEXT,
+        )
+    )
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-2)
+
+
+def test_gram_matrix_fractional_values():
+    rng = np.random.default_rng(2)
+    batch = random_batch(rng)
+    token_val = batch.token_val * 0.37  # non-integral: one bf16 plane can't hold it
+    dense = np.asarray(densify_text(jnp.asarray(batch.token_idx), jnp.asarray(token_val), F_TEXT))
+    z = np.concatenate([dense, batch.numeric], axis=1)
+    ref = z @ z.T
+    got = np.asarray(
+        gram_matrix(
+            jnp.asarray(batch.token_idx),
+            jnp.asarray(token_val),
+            jnp.asarray(batch.numeric),
+            F_TEXT,
+        )
+    )
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-2)
+
+
+def test_multi_batch_trajectory_matches_scatter():
+    rng = np.random.default_rng(3)
+    batches = [random_batch(rng) for _ in range(4)]
+    w0 = zero_weights(F_TEXT)
+    res = both_paths(batches, w0)
+    assert_trajectories_match(*res)
+
+
+def test_l2_scales_untouched_weights_identically():
+    """W_prev entries the batch never references must shrink by the exact
+    per-iteration (1 − η·λ) product — the lazy c-scale of the dual basis
+    against the scatter loop's explicit full-vector scaling."""
+    rng = np.random.default_rng(4)
+    # tokens confined to [0, 64): features ≥ 64 are untouched by every batch
+    batches = []
+    for _ in range(3):
+        b = random_batch(rng)
+        batches.append(b._replace(token_idx=(b.token_idx % 64).astype(np.int32)))
+    w0 = rng.normal(size=(F_TEXT + NUM_NUMBER_FEATURES,)).astype(np.float32)
+    res_s, res_g = both_paths(batches, w0, l2_reg=0.05, convergence_tol=0.0)
+    assert_trajectories_match(res_s, res_g)
+    # untouched entries did change (the L2 shrink really applied)...
+    w_s = res_s[0]
+    assert not np.allclose(w_s[64:F_TEXT], w0[64:F_TEXT])
+    # ...multiplicatively, by the same factor everywhere
+    ratio = w_s[64:F_TEXT] / w0[64:F_TEXT]
+    np.testing.assert_allclose(ratio, ratio[0], rtol=1e-5)
+
+
+def test_mini_batch_sampling_matches():
+    rng = np.random.default_rng(5)
+    batches = [random_batch(rng) for _ in range(3)]
+    res = both_paths(batches, zero_weights(F_TEXT), mini_batch_fraction=0.5)
+    assert_trajectories_match(*res)
+
+
+def test_convergence_freeze_matches():
+    """A tight tolerance freezes both formulations at the same iteration;
+    trajectories (and therefore the frozen weights) agree."""
+    rng = np.random.default_rng(6)
+    batches = [random_batch(rng, label_scale=1.0)]
+    res = both_paths(
+        batches, zero_weights(F_TEXT), convergence_tol=0.05, num_iterations=50
+    )
+    assert_trajectories_match(*res)
+
+
+def test_zero_valid_batch_is_identity():
+    rng = np.random.default_rng(7)
+    b = random_batch(rng)
+    empty = b._replace(mask=np.zeros_like(b.mask))
+    w0 = rng.normal(size=(F_TEXT + NUM_NUMBER_FEATURES,)).astype(np.float32)
+    step = make_sgd_train_step(
+        num_text_features=F_TEXT, use_sparse=True, use_gram=True,
+        num_iterations=10, step_size=0.05, l2_reg=0.1,
+    )
+    w1, _ = step(jnp.asarray(w0), empty)
+    np.testing.assert_allclose(np.asarray(w1), w0, rtol=1e-6, atol=0)
+
+
+def test_logistic_residual_matches():
+    rng = np.random.default_rng(8)
+    batches = []
+    for _ in range(3):
+        b = random_batch(rng)
+        batches.append(b._replace(label=(b.label > 25).astype(np.float32) * b.mask))
+    cls = StreamingLogisticRegressionWithSGD
+    res = both_paths(
+        batches,
+        zero_weights(F_TEXT),
+        residual_fn=cls.residual_fn,
+        prediction_fn=cls.prediction_fn,
+        round_predictions=cls.round_predictions,
+        step_size=0.5,
+    )
+    assert_trajectories_match(*res)
+
+
+def test_unit_batch_rides_gram_path():
+    """UnitBatch → on-device hash → Gram loop equals the same UnitBatch
+    through the scatter loop (hash runs in both programs identically)."""
+    rng = np.random.default_rng(9)
+    texts = ["tpu stream %d" % i for i in range(8)]
+    units = np.zeros((8, 16), np.uint16)
+    length = np.zeros((8,), np.int32)
+    for i, t in enumerate(texts):
+        enc = np.frombuffer(t.encode("utf-16-le"), np.uint16)
+        units[i, : len(enc)] = enc
+        length[i] = len(enc)
+    batch = UnitBatch(  # jnp arrays: the step runs unjitted in this test
+        jnp.asarray(units),
+        jnp.asarray(length),
+        rng.normal(size=(8, NUM_NUMBER_FEATURES)).astype(np.float32) * 0.1,
+        rng.uniform(0, 50, size=(8,)).astype(np.float32),
+        np.ones((8,), np.float32),
+    )
+    res = both_paths([batch], zero_weights(F_TEXT))
+    assert_trajectories_match(*res)
+
+
+def test_gram_matrix_mixed_sign_values_stay_exact():
+    """Row-sum cancellation must not fool the bf16-exactness gate: mixed-sign
+    integral values whose sum is small but whose per-feature count magnitude
+    exceeds 255 must take the exact fallback."""
+    token_idx = np.array([[7, 7, 9, 0]], np.int32)
+    token_val = np.array([[150.0, 151.0, -200.0, 0.0]], np.float32)
+    numeric = np.zeros((1, NUM_NUMBER_FEATURES), np.float32)
+    got = np.asarray(
+        gram_matrix(
+            jnp.asarray(token_idx),
+            jnp.asarray(token_val),
+            jnp.asarray(numeric),
+            F_TEXT,
+        )
+    )
+    # exact: 301² + 200² = 130601
+    np.testing.assert_allclose(got[0, 0], 301.0**2 + 200.0**2, rtol=1e-6)
+
+
+def test_bfloat16_weights_run_the_gram_loop():
+    """Explicit use_gram with bf16 weights must trace (type-stable fori_loop
+    carry) and track the bf16 scatter path."""
+    rng = np.random.default_rng(11)
+    batches = [random_batch(rng) for _ in range(2)]
+    w0 = zero_weights(F_TEXT, dtype=jnp.bfloat16)
+    (w_s, _), (w_g, _) = both_paths(batches, w0)
+    np.testing.assert_allclose(
+        np.asarray(w_g, np.float32), np.asarray(w_s, np.float32),
+        rtol=0.1, atol=0.1,  # bf16 trajectories diverge fast; same ballpark
+    )
+
+
+def test_auto_gate_is_f32_only():
+    """The default path must not auto-select Gram for non-f32 weights (the
+    bf16-plane G build would silently change f64 semantics)."""
+    rng = np.random.default_rng(12)
+    b = random_batch(rng)
+    step = make_sgd_train_step(
+        num_text_features=F_TEXT, use_sparse=True,
+        num_iterations=5, step_size=0.05,
+    )
+    # bf16 weights trace and run through the (auto-selected) scatter loop
+    w0 = zero_weights(F_TEXT, dtype=jnp.bfloat16)
+    w1, _ = step(jnp.asarray(w0), b)
+    assert w1.dtype == jnp.bfloat16
+
+
+def test_auto_gate_picks_gram_only_when_it_fits():
+    assert fits_gram(2048, 2**18, 50)
+    assert not fits_gram(2048, 2**18, 2)  # too few iterations to amortize
+    assert not fits_gram(1 << 20, 2**18, 50)  # dense counts exceed HBM budget
+
+
+def test_gram_with_data_axis_is_rejected():
+    with pytest.raises(ValueError):
+        make_sgd_train_step(
+            num_text_features=F_TEXT, use_sparse=True, use_gram=True,
+            num_iterations=10, step_size=0.05, axis_name="data",
+        )

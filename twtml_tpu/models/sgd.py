@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..features.batch import NUM_NUMBER_FEATURES, FeatureBatch, UnitBatch
+from ..ops.gram import dual_norm_sq, dual_writeback, fits_gram, gram_matrix
 from ..ops.sparse import densify_text, sparse_grad_text, sparse_predict
 from ..ops.stats import batch_stats
 from ..ops.text_hash import hash_bigrams_device
@@ -147,6 +148,7 @@ def make_sgd_train_step(
     axis_name: str | None = None,
     use_sparse: bool | None = None,
     round_predictions: bool = True,
+    use_gram: bool | None = None,
 ):
     """Build the fused (weights, batch) → (new_weights, StepOutput) step.
 
@@ -162,9 +164,24 @@ def make_sgd_train_step(
     knob here: at these shapes the step is micro-seconds on device for both
     implementations and the difference is unmeasurable through this build's
     dispatch transport — see BENCHMARKS.md for the full measurement story.
+
+    In the sparse regime the iterations run in the dual (Gram) basis by
+    default (ops/gram.py): one MXU matmul builds G = Z·Zᵀ per batch and the
+    loop never touches the 2^18 feature space — ~25× the per-iteration
+    gather/scatter formulation on a v5e chip at B=2048. ``use_gram`` False
+    forces the scatter loop (the differential baseline, and the only
+    formulation available when rows are sharded over a data axis, where G
+    would need cross-shard row products); None picks the Gram path whenever
+    it applies (single-device sparse, dense counts within HBM budget —
+    ops/gram.py ``fits_gram``).
     """
     f_text = num_text_features
     sparse = f_text > DENSE_TEXT_FEATURE_LIMIT if use_sparse is None else use_sparse
+    if use_gram and axis_name:
+        raise ValueError(
+            "use_gram=True cannot combine with a data axis: G = Z·Zᵀ needs "
+            "cross-shard row products; row-sharded layouts use the scatter loop"
+        )
     residual_fn = residual_fn or (lambda raw, label: raw - label)
     prediction_fn = prediction_fn or (lambda raw: raw)
 
@@ -187,6 +204,49 @@ def make_sgd_train_step(
             g_num = residual @ batch.numeric.astype(residual.dtype)
             return jnp.concatenate([g_text, g_num])
         return x_dense.T @ residual
+
+    def _gram_sgd(weights, batch: FeatureBatch, u, mask, labels):
+        """The sparse inner loop in the dual basis (ops/gram.py): same
+        ``sgd_inner_loop`` semantics over the tiny state {c, α}; the feature
+        space is touched only by the G build and the final write-back."""
+        dtype = weights.dtype
+        numeric = batch.numeric.astype(dtype)
+        # G is built in f32 (the MXU accumulation type); the dual loop runs
+        # in the weights dtype so the fori_loop carry stays type-stable for
+        # low-precision weights. f64 weights never reach here (the auto gate
+        # is f32-only — the bf16-plane G build would silently downgrade f64).
+        g = gram_matrix(batch.token_idx, batch.token_val, numeric, f_text).astype(
+            dtype
+        )
+        p_prev = jnp.sum(weights * weights)
+
+        def grad_and_count(w, sel):
+            raw = w["c"] * u + g @ w["alpha"]
+            residual = residual_fn(raw, labels) * sel
+            return {"c": jnp.zeros((), dtype), "alpha": residual}, jnp.sum(sel)
+
+        dual = sgd_inner_loop(
+            {"c": jnp.ones((), dtype), "alpha": jnp.zeros(labels.shape, dtype)},
+            num_iterations=num_iterations,
+            step_size=step_size,
+            mini_batch_fraction=mini_batch_fraction,
+            l2_reg=l2_reg,
+            convergence_tol=convergence_tol,
+            mask=mask,
+            sample_key=sampling_key(None, mini_batch_fraction),
+            grad_and_count=grad_and_count,
+            norm_sq=dual_norm_sq(p_prev, u, g),
+        )
+        w_text_new, w_num_new = dual_writeback(
+            weights[:f_text],
+            weights[f_text:],
+            dual["c"],
+            dual["alpha"],
+            batch.token_idx,
+            batch.token_val,
+            numeric,
+        )
+        return jnp.concatenate([w_text_new, w_num_new])
 
     def train_step(weights, batch: FeatureBatch | UnitBatch):
         dtype = weights.dtype
@@ -226,6 +286,20 @@ def make_sgd_train_step(
         stats = batch_stats(labels, preds, mask, axis_name)
 
         # ---- numIterations of mini-batch SGD ----------------------------
+        gram = (
+            sparse
+            and axis_name is None
+            and dtype == jnp.float32  # see dtype note in _gram_sgd
+            and fits_gram(batch.mask.shape[0], f_text, num_iterations)
+            if use_gram is None
+            else use_gram
+        )
+        if gram:
+            # ``raw`` above is u = Z·W_prev — the dual loop starts from it
+            return _gram_sgd(weights, batch, raw, mask, labels), StepOutput(
+                predictions=preds, **stats
+            )
+
         def grad_and_count(w, sel):
             residual = residual_fn(_predict_raw(w, batch, x_dense), labels) * sel
             grad_sum = _grad_sum(batch, x_dense, residual)

@@ -1,5 +1,6 @@
 from .stats import masked_mean, masked_stdev, batch_stats
 from .sparse import densify_text, sparse_predict, sparse_grad_text, sparse_text_dot
+from .gram import gram_matrix, fits_gram
 
 __all__ = [
     "masked_mean",
@@ -9,4 +10,6 @@ __all__ = [
     "sparse_predict",
     "sparse_grad_text",
     "sparse_text_dot",
+    "gram_matrix",
+    "fits_gram",
 ]

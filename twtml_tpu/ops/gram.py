@@ -1,0 +1,164 @@
+"""Gram-domain (dual) SGD — the sparse inner loop re-expressed as MXU matmuls.
+
+The 2^18-dim sparse regime (BASELINE config #4) was device-bound in r1/r2:
+every one of the ``numIterations`` (50) rounds of MLlib's GradientDescent
+loop (SURVEY.md §3.3) did a [B·L]-wide gather plus a scatter-add into the
+2^18-entry weight vector — XLA lowers those to serialized scatter updates,
+~100 ms/step on a v5e chip.
+
+The fix is algebra, not a kernel. Within one micro-batch the design matrix
+``Z = [X_text | numeric]`` is FIXED across all iterations; the loop only ever
+needs ``Z·W`` (predictions) and ``Zᵀ·r`` (gradient). Re-parameterize the
+weight trajectory in the span the updates actually live in:
+
+    W_i = c_i · W_prev + Zᵀ · α_i          (c_0 = 1, α_0 = 0)
+
+Then with ``u = Z·W_prev`` ([B], the batch's pre-update predictions) and
+``G = Z·Zᵀ`` ([B,B], the Gram matrix):
+
+    Z·W_i     = c_i·u + G·α_i              — a [B,B]×[B] matvec
+    update    : c ← c·(1−ηλ);  α ← α·(1−ηλ) − η·(sel·r)/denom
+    ‖W_a−W_b‖² = Δc²·‖W_prev‖² + 2·Δc·(u·Δα) + Δαᵀ·G·Δα
+
+so MLlib's exact update rule — √-decay step, SquaredL2Updater pre-scale,
+Bernoulli sampling, zero-sample skip, convergence freeze — runs unchanged
+through ``sgd_inner_loop`` on the tiny dual state {c, α}, and the 2^18
+feature space is touched exactly twice per batch: once building the dense
+count matrix for G (one scatter + ONE bf16×bf16→f32 matmul on the MXU) and
+once scattering ``Zᵀα`` back at write-back. The residual function enters
+only elementwise on ``Z·W``, so the same dual loop serves the logistic
+learner. Nothing here is approximate: it is the same recursion in a
+different basis (floating-point summation order differs; differential tests
+in tests/test_gram_sgd.py pin both paths together).
+
+Even the G build avoids scatters. XLA lowers a [B·L]-update scatter into
+[B, 2^18] to ~220 ns/update on a v5e chip (~28 ms/batch — it would dominate
+the whole step), so the dense count matrix is instead built as a batched
+MXU matmul over a two-level split of the feature index, ``f = hi·K + lo``:
+
+    C[b, hi, lo] = Σ_l val[b,l] · 1[hi_l = hi] · 1[lo_l = lo]
+                 = (OHhiᵀ · diag(val) · OHlo)[hi, lo]       per row b
+
+i.e. one ``[B, √F, L] × [B, L, √F]`` batched matmul (~0.07 TFLOP at
+B=2048, F=2^18 — 3% of the G matmul itself), with 0/1 one-hot operands
+that are exact in bf16 and f32 accumulation, so counts come out exact.
+
+Exactness is cond-gated at runtime, never assumed: token values that don't
+round-trip through bf16 fall back to the f32 scatter densify, and a count
+matrix that doesn't round-trip through bf16 (a per-row-feature count above
+256 — beyond any real tweet) promotes the G matmul to
+``Precision.HIGHEST``. G is therefore (near-)exact for every input the
+scatter path accepts, and fast for every input that can occur.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .sparse import densify_text
+
+# Above this dense-counts footprint (B·F·4 bytes) the Gram build would not
+# fit comfortably in HBM next to the program's other buffers; the learner
+# falls back to the per-iteration gather/scatter loop. 4 GB leaves >10 GB
+# headroom on a 16 GB v5e chip for G, the bf16 planes, and the weights.
+GRAM_DENSE_BYTES_LIMIT = 4 << 30
+
+# Below this iteration count the Gram build (one densify scatter + one
+# matmul) costs about as much as just running the scatter loop.
+GRAM_MIN_ITERATIONS = 4
+
+
+def fits_gram(batch_rows: int, f_text: int, num_iterations: int) -> bool:
+    """Static-shape gate: use the Gram path when the dense counts matrix
+    fits the HBM budget and there are enough iterations to amortize it.
+    All inputs are trace-time constants, so this never recompiles."""
+    return (
+        num_iterations >= GRAM_MIN_ITERATIONS
+        and batch_rows * f_text * 4 <= GRAM_DENSE_BYTES_LIMIT
+    )
+
+
+def onehot_counts(token_idx, token_val, f_text: int, dtype=jnp.bfloat16):
+    """[B, L] (idx, val) pairs → dense [B, F] ``dtype`` counts with NO
+    scatter: the two-level one-hot batched matmul of the module docstring.
+    Accumulation is f32 regardless of ``dtype``; the output cast fuses into
+    the matmul epilogue, so the bf16 default halves the write (and the
+    downstream G matmul's read) vs an f32 count matrix."""
+    b, l = token_idx.shape
+    lo_bits = (max(f_text - 1, 1).bit_length() + 1) // 2
+    k_lo = 1 << lo_bits
+    k_hi = -(-f_text // k_lo)
+    hi = token_idx // k_lo
+    lo = token_idx % k_lo
+    oh_hi = (hi[:, :, None] == jnp.arange(k_hi, dtype=hi.dtype)).astype(
+        jnp.bfloat16
+    ) * token_val[:, :, None].astype(jnp.bfloat16)
+    oh_lo = (lo[:, :, None] == jnp.arange(k_lo, dtype=lo.dtype)).astype(jnp.bfloat16)
+    c = lax.dot_general(
+        oh_hi,
+        oh_lo,
+        (((1,), (1,)), ((0,), (0,))),  # contract over l, batch over b
+        preferred_element_type=jnp.float32,
+    ).astype(dtype)  # [B, k_hi, k_lo]
+    return c.reshape(b, k_hi * k_lo)[:, :f_text]
+
+
+def gram_matrix(token_idx, token_val, numeric, f_text: int):
+    """G = Z·Zᵀ ([B,B] f32) for Z = [text counts | numeric features].
+
+    Common path (every real tweet): token values are small integers and each
+    row's total token mass is ≤ 255, which PROVES every count is an integer
+    ≤ 255 and therefore bf16-exact — so the count matrix is built by the
+    one-hot matmul straight into bf16 and G is one bf16×bf16→f32 MXU matmul.
+    The predicate costs one pass over the [B, L] token values (not the
+    [B, F] counts). Anything else — fractional values, a degenerate row with
+    > 255 mass — takes the exact fallback: f32 scatter densify + full-f32
+    (``Precision.HIGHEST``) matmul.
+    """
+    val_f = token_val.astype(jnp.float32)
+    # integral, bf16-representable values with row ABSOLUTE mass ≤ 255 ⇒
+    # every count is an integer of magnitude ≤ 255 ⇒ counts and their bf16
+    # products are exact (plain sum would be unsound for mixed-sign values:
+    # cancellation can hide a per-feature count above the bf16 range)
+    vals_ok = (
+        jnp.all(val_f == jnp.round(val_f))
+        & jnp.all(val_f.astype(jnp.bfloat16).astype(jnp.float32) == val_f)
+        & jnp.all(jnp.sum(jnp.abs(val_f), axis=1) <= 255.0)
+    )
+
+    def fast(i, v):
+        c = onehot_counts(i, v, f_text)  # [B, F] bf16, exact
+        return jnp.matmul(c, c.T, preferred_element_type=jnp.float32)
+
+    def exact(i, v):
+        c = densify_text(i, v, f_text)  # [B, F] f32
+        return jnp.matmul(c, c.T, precision=lax.Precision.HIGHEST)
+
+    g_text = lax.cond(vals_ok, fast, exact, token_idx, val_f)
+    num = numeric.astype(jnp.float32)
+    return g_text + num @ num.T
+
+
+def dual_norm_sq(p_prev, u, g):
+    """‖W_a − W_b‖² evaluated in the dual basis — the ``norm_sq`` hook for
+    ``sgd_inner_loop`` (convergence tolerance), given ``p_prev = ‖W_prev‖²``,
+    ``u = Z·W_prev`` and the Gram matrix ``g``."""
+
+    def norm_sq(a, b):
+        dc = a["c"] - b["c"]
+        da = a["alpha"] - b["alpha"]
+        return dc * dc * p_prev + 2.0 * dc * jnp.dot(u, da) + jnp.dot(da, g @ da)
+
+    return norm_sq
+
+
+def dual_writeback(w_text, w_num, c, alpha, token_idx, token_val, numeric):
+    """W_new = c·W_prev + Zᵀ·α — the one feature-space scatter of the batch.
+
+    Contributions for duplicate (row, feature) occurrences sum, exactly as
+    the per-iteration ``sparse_grad_text`` scatter summed them."""
+    contrib = token_val * alpha[:, None]  # [B, L]
+    w_text_new = (w_text * c).at[token_idx.reshape(-1)].add(contrib.reshape(-1))
+    w_num_new = w_num * c + numeric.T @ alpha
+    return w_text_new, w_num_new
