@@ -9,7 +9,6 @@ densify-matmul reference, including the cond-gated two-plane split for
 counts > 255 and non-integral token values."""
 
 import numpy as np
-import pytest
 
 import jax.numpy as jnp
 
@@ -276,15 +275,117 @@ def test_auto_gate_is_f32_only():
     assert w1.dtype == jnp.bfloat16
 
 
+def test_feature_sharded_gram_sampling_matches_single_device():
+    """2D (data × model) mesh with fraction < 1: the gram path's one global
+    mask must bit-match the single-device gram trajectory."""
+    import jax
+
+    from twtml_tpu.parallel import ParallelSGDModel, make_mesh
+    from twtml_tpu.parallel.sharding import shard_batch
+
+    rng = np.random.default_rng(15)
+    batches = [random_batch(rng, b=32) for _ in range(2)]
+    single = make_sgd_train_step(
+        num_text_features=F_TEXT, use_sparse=True, use_gram=True,
+        num_iterations=20, step_size=0.05, mini_batch_fraction=0.5, l2_reg=0.01,
+    )
+    w_ref, _ = run_chain(single, batches, zero_weights(F_TEXT))
+
+    mesh = make_mesh(num_data=2, num_model=4)
+    model = ParallelSGDModel(
+        mesh, num_text_features=F_TEXT, num_iterations=20, step_size=0.05,
+        mini_batch_fraction=0.5, l2_reg=0.01, use_gram=True,
+    )
+    for b in batches:
+        model.step(shard_batch(b, mesh))
+    np.testing.assert_allclose(model.latest_weights, w_ref, rtol=2e-4, atol=2e-4)
+
+
+def test_feature_sharded_gram_vs_scatter():
+    """Same 2D mesh, gram vs scatter formulations agree (fraction=1 so the
+    sampling layouts coincide)."""
+    import jax
+
+    from twtml_tpu.parallel import ParallelSGDModel, make_mesh
+    from twtml_tpu.parallel.sharding import shard_batch
+
+    rng = np.random.default_rng(16)
+    batches = [random_batch(rng, b=32) for _ in range(2)]
+    mesh = make_mesh(num_data=2, num_model=4)
+    kw = dict(
+        num_text_features=F_TEXT, num_iterations=15, step_size=0.05, l2_reg=0.02
+    )
+    m_gram = ParallelSGDModel(mesh, use_gram=True, **kw)
+    m_scat = ParallelSGDModel(mesh, use_gram=False, **kw)
+    for b in batches:
+        sb = shard_batch(b, mesh)
+        og, os_ = m_gram.step(sb), m_scat.step(sb)
+        np.testing.assert_allclose(float(og.mse), float(os_.mse), rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(
+        m_gram.latest_weights, m_scat.latest_weights, rtol=2e-4, atol=2e-4
+    )
+
+
 def test_auto_gate_picks_gram_only_when_it_fits():
     assert fits_gram(2048, 2**18, 50)
     assert not fits_gram(2048, 2**18, 2)  # too few iterations to amortize
     assert not fits_gram(1 << 20, 2**18, 50)  # dense counts exceed HBM budget
 
 
-def test_gram_with_data_axis_is_rejected():
-    with pytest.raises(ValueError):
-        make_sgd_train_step(
-            num_text_features=F_TEXT, use_sparse=True, use_gram=True,
-            num_iterations=10, step_size=0.05, axis_name="data",
-        )
+def test_data_axis_gram_matches_single_device():
+    """Row-sharded Gram (all-gathered batch, sharded G row panels, replicated
+    dual loop) must reproduce the single-device trajectory: same global
+    batch, same unfolded sampling key — the collectives are the only
+    difference."""
+    import jax
+
+    from twtml_tpu.parallel import ParallelSGDModel, make_mesh
+    from twtml_tpu.parallel.sharding import shard_batch
+
+    rng = np.random.default_rng(13)
+    batches = [random_batch(rng, b=32) for _ in range(3)]
+
+    single = make_sgd_train_step(
+        num_text_features=F_TEXT, use_sparse=True, use_gram=True,
+        num_iterations=25, step_size=0.05, l2_reg=0.01,
+    )
+    w_ref, outs_ref = run_chain(single, batches, zero_weights(F_TEXT))
+
+    mesh = make_mesh(num_data=4, devices=jax.devices()[:4])
+    model = ParallelSGDModel(
+        mesh, num_text_features=F_TEXT, num_iterations=25,
+        step_size=0.05, l2_reg=0.01, use_sparse=True,
+    )
+    outs = [model.step(shard_batch(b, mesh)) for b in batches]
+    np.testing.assert_allclose(
+        model.latest_weights, w_ref, rtol=2e-4, atol=2e-4
+    )
+    for a, b in zip(outs_ref, outs):
+        np.testing.assert_allclose(float(b.mse), float(a.mse), rtol=1e-4, atol=1e-3)
+
+
+def test_data_axis_gram_sampling_matches_single_device():
+    """fraction < 1: the gram data-axis path draws ONE global mask with the
+    unfolded key, so it must bit-match the single-device gram trajectory
+    (the scatter loop's per-shard folded keys only match statistically)."""
+    import jax
+
+    from twtml_tpu.parallel import ParallelSGDModel, make_mesh
+    from twtml_tpu.parallel.sharding import shard_batch
+
+    rng = np.random.default_rng(14)
+    batches = [random_batch(rng, b=32) for _ in range(2)]
+    single = make_sgd_train_step(
+        num_text_features=F_TEXT, use_sparse=True, use_gram=True,
+        num_iterations=20, step_size=0.05, mini_batch_fraction=0.5,
+    )
+    w_ref, _ = run_chain(single, batches, zero_weights(F_TEXT))
+
+    mesh = make_mesh(num_data=4, devices=jax.devices()[:4])
+    model = ParallelSGDModel(
+        mesh, num_text_features=F_TEXT, num_iterations=20,
+        step_size=0.05, mini_batch_fraction=0.5, use_sparse=True,
+    )
+    for b in batches:
+        model.step(shard_batch(b, mesh))
+    np.testing.assert_allclose(model.latest_weights, w_ref, rtol=2e-4, atol=2e-4)

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..features.batch import NUM_NUMBER_FEATURES, FeatureBatch, UnitBatch
-from ..ops.gram import dual_norm_sq, dual_writeback, fits_gram, gram_matrix
+from ..ops.gram import dual_norm_sq, dual_writeback, fits_gram, text_gram
 from ..ops.sparse import densify_text, sparse_grad_text, sparse_predict
 from ..ops.stats import batch_stats
 from ..ops.text_hash import hash_bigrams_device
@@ -60,6 +60,7 @@ def sgd_inner_loop(
     sample_key,
     grad_and_count: Callable,
     norm_sq: Callable | None = None,
+    vary_axis: str | None = None,
 ):
     """The MLlib GradientDescent iteration loop over an arbitrary weight
     pytree — the ONE place the parity-critical semantics live (1-indexed
@@ -71,7 +72,10 @@ def sgd_inner_loop(
     ``grad_and_count(w, sel)`` must return (gradient-sum pytree, selected
     count), already globally reduced across any mesh axes. ``norm_sq(a, b)``
     returns the global ‖a−b‖² for convergence (default: local sum over
-    leaves; sharded layouts pass a psum-ing version).
+    leaves; sharded layouts pass a psum-ing version). ``vary_axis`` marks
+    the loop carry as varying over a manual mesh axis — required when the
+    body consumes axis-varying values (e.g. an all-gathered batch) whose
+    varying-ness would otherwise mismatch the constant-initialized carry.
     """
     dtype = jax.tree_util.tree_leaves(weights)[0].dtype
 
@@ -119,8 +123,76 @@ def sgd_inner_loop(
         )
         return w_out, converged | conv_now
 
-    w_final, _ = lax.fori_loop(0, num_iterations, body, (weights, jnp.array(False)))
+    converged0 = jnp.array(False)
+    if vary_axis:
+        to_varying = lambda x: lax.pcast(x, vary_axis, to="varying")
+        weights = jax.tree_util.tree_map(to_varying, weights)
+        converged0 = to_varying(converged0)
+    w_final, _ = lax.fori_loop(0, num_iterations, body, (weights, converged0))
     return w_final
+
+
+def run_dual_loop(
+    *,
+    u,
+    g,
+    labels,
+    mask,
+    dtype,
+    residual_fn: Callable,
+    num_iterations: int,
+    step_size: float,
+    mini_batch_fraction: float,
+    l2_reg: float,
+    convergence_tol: float,
+    p_prev,
+    vary_axis: str | None = None,
+):
+    """MLlib's iteration loop in the Gram (dual) basis — the ONE dual-state
+    driver both the single-device sparse step (``_gram_sgd`` below) and the
+    feature-sharded step (parallel/sharding.py) call, so the parity-critical
+    construction (state init, grad shape, sampling key, convergence norm)
+    cannot de-synchronize between layouts.
+
+    All row-dimensioned inputs (``u = Z·W_prev``, ``labels``, ``mask``, and
+    G's rows) are GLOBAL; under a mesh the loop runs replicated on every
+    shard — it is [B]-sized, and collective-free. Sampling draws ONE global
+    mask with the unfolded MLlib key, bit-matching the single-device
+    trajectory (the scatter loop's per-shard folded keys only match it
+    statistically — ``sampling_key`` docstring). Returns the dual state
+    {'c', 'alpha'}: W_new = c·W_prev + Zᵀα (write-back is layout-specific).
+    """
+
+    def grad_and_count(w, sel):
+        raw = w["c"] * u + g @ w["alpha"]
+        residual = residual_fn(raw, labels) * sel
+        return {"c": jnp.zeros((), dtype), "alpha": residual}, jnp.sum(sel)
+
+    return sgd_inner_loop(
+        {"c": jnp.ones((), dtype), "alpha": jnp.zeros(labels.shape, dtype)},
+        num_iterations=num_iterations,
+        step_size=step_size,
+        mini_batch_fraction=mini_batch_fraction,
+        l2_reg=l2_reg,
+        convergence_tol=convergence_tol,
+        mask=mask,
+        sample_key=sampling_key(None, mini_batch_fraction),
+        grad_and_count=grad_and_count,
+        norm_sq=dual_norm_sq(p_prev, u, g),
+        vary_axis=vary_axis,
+    )
+
+
+def dual_scale_and_alpha(dual, axis_name: str, rows: int):
+    """This shard's slice of the dual state for a sharded write-back:
+    (c, α_local). The psum-mean of c turns the identical-everywhere scale
+    into a statically-invariant value (shard_map's replicated-output check),
+    and slicing α to local rows keeps the write-back scatter 1/shards."""
+    alpha_local = lax.dynamic_slice_in_dim(
+        dual["alpha"], lax.axis_index(axis_name) * rows, rows
+    )
+    c = lax.psum(dual["c"], axis_name) / lax.axis_size(axis_name)
+    return c, alpha_local
 
 
 def sampling_key(axis_name: str | None, mini_batch_fraction: float):
@@ -168,20 +240,17 @@ def make_sgd_train_step(
     In the sparse regime the iterations run in the dual (Gram) basis by
     default (ops/gram.py): one MXU matmul builds G = Z·Zᵀ per batch and the
     loop never touches the 2^18 feature space — ~25× the per-iteration
-    gather/scatter formulation on a v5e chip at B=2048. ``use_gram`` False
-    forces the scatter loop (the differential baseline, and the only
-    formulation available when rows are sharded over a data axis, where G
-    would need cross-shard row products); None picks the Gram path whenever
-    it applies (single-device sparse, dense counts within HBM budget —
-    ops/gram.py ``fits_gram``).
+    gather/scatter formulation on a v5e chip at B=2048. With a data axis the
+    batch is all-gathered once (G needs cross-shard row products), each
+    shard computes its row panel of G (matmul FLOPs scale 1/shards), one
+    all-gather replicates G, and the tiny dual loop runs replicated with NO
+    per-iteration collectives — versus one gradient psum per iteration (50/
+    batch) in the scatter loop. ``use_gram`` False forces the scatter loop
+    (the differential baseline); None picks Gram whenever it applies (f32
+    weights, dense counts within HBM budget — ops/gram.py ``fits_gram``).
     """
     f_text = num_text_features
     sparse = f_text > DENSE_TEXT_FEATURE_LIMIT if use_sparse is None else use_sparse
-    if use_gram and axis_name:
-        raise ValueError(
-            "use_gram=True cannot combine with a data axis: G = Z·Zᵀ needs "
-            "cross-shard row products; row-sharded layouts use the scatter loop"
-        )
     residual_fn = residual_fn or (lambda raw, label: raw - label)
     prediction_fn = prediction_fn or (lambda raw: raw)
 
@@ -205,47 +274,71 @@ def make_sgd_train_step(
             return jnp.concatenate([g_text, g_num])
         return x_dense.T @ residual
 
-    def _gram_sgd(weights, batch: FeatureBatch, u, mask, labels):
-        """The sparse inner loop in the dual basis (ops/gram.py): same
-        ``sgd_inner_loop`` semantics over the tiny state {c, α}; the feature
-        space is touched only by the G build and the final write-back."""
+    def _gram_sgd(weights, row_args, local_args):
+        """The sparse inner loop in the dual basis: build G (row panels
+        sharded under a data axis), drive the shared ``run_dual_loop``, and
+        write back — locally, or slice-local + psum under a data axis (which
+        both shrinks the scatter 1/shards and gives the replicated-weights
+        output the statically-invariant form shard_map requires).
+
+        ``row_args`` are GLOBAL (the caller all-gathers the batch under a
+        data axis); ``local_args`` are this shard's rows."""
+        token_idx, token_val, numeric, u, mask, labels = row_args
         dtype = weights.dtype
-        numeric = batch.numeric.astype(dtype)
         # G is built in f32 (the MXU accumulation type); the dual loop runs
         # in the weights dtype so the fori_loop carry stays type-stable for
         # low-precision weights. f64 weights never reach here (the auto gate
         # is f32-only — the bf16-plane G build would silently downgrade f64).
-        g = gram_matrix(batch.token_idx, batch.token_val, numeric, f_text).astype(
-            dtype
-        )
-        p_prev = jnp.sum(weights * weights)
+        if axis_name:
+            rows = u.shape[0] // lax.axis_size(axis_name)
+            panel = text_gram(
+                token_idx,
+                token_val,
+                f_text,
+                row_start=lax.axis_index(axis_name) * rows,
+                rows=rows,
+            )  # [B_local, B_global]: FLOPs scale 1/shards
+            g_text = lax.all_gather(panel, axis_name, axis=0, tiled=True)
+        else:
+            g_text = text_gram(token_idx, token_val, f_text)
+        num32 = numeric.astype(jnp.float32)
+        g = (g_text + num32 @ num32.T).astype(dtype)
 
-        def grad_and_count(w, sel):
-            raw = w["c"] * u + g @ w["alpha"]
-            residual = residual_fn(raw, labels) * sel
-            return {"c": jnp.zeros((), dtype), "alpha": residual}, jnp.sum(sel)
-
-        dual = sgd_inner_loop(
-            {"c": jnp.ones((), dtype), "alpha": jnp.zeros(labels.shape, dtype)},
+        dual = run_dual_loop(
+            u=u,
+            g=g,
+            labels=labels,
+            mask=mask,
+            dtype=dtype,
+            residual_fn=residual_fn,
             num_iterations=num_iterations,
             step_size=step_size,
             mini_batch_fraction=mini_batch_fraction,
             l2_reg=l2_reg,
             convergence_tol=convergence_tol,
-            mask=mask,
-            sample_key=sampling_key(None, mini_batch_fraction),
-            grad_and_count=grad_and_count,
-            norm_sq=dual_norm_sq(p_prev, u, g),
+            p_prev=jnp.sum(weights * weights),
+            vary_axis=axis_name,
         )
-        w_text_new, w_num_new = dual_writeback(
-            weights[:f_text],
-            weights[f_text:],
-            dual["c"],
-            dual["alpha"],
-            batch.token_idx,
-            batch.token_val,
-            numeric,
-        )
+        if axis_name:
+            l_idx, l_val, l_num = local_args
+            c, alpha_local = dual_scale_and_alpha(dual, axis_name, l_val.shape[0])
+            delta_text = lax.psum(
+                sparse_grad_text(l_idx, l_val, alpha_local, f_text), axis_name
+            )
+            w_text_new = weights[:f_text] * c + delta_text
+            w_num_new = weights[f_text:] * c + lax.psum(
+                l_num.T @ alpha_local, axis_name
+            )
+        else:
+            w_text_new, w_num_new = dual_writeback(
+                weights[:f_text],
+                weights[f_text:],
+                dual["c"],
+                dual["alpha"],
+                token_idx,
+                token_val,
+                numeric,
+            )
         return jnp.concatenate([w_text_new, w_num_new])
 
     def train_step(weights, batch: FeatureBatch | UnitBatch):
@@ -286,17 +379,27 @@ def make_sgd_train_step(
         stats = batch_stats(labels, preds, mask, axis_name)
 
         # ---- numIterations of mini-batch SGD ----------------------------
+        b_global = batch.mask.shape[0] * (lax.axis_size(axis_name) if axis_name else 1)
         gram = (
             sparse
-            and axis_name is None
             and dtype == jnp.float32  # see dtype note in _gram_sgd
-            and fits_gram(batch.mask.shape[0], f_text, num_iterations)
+            and fits_gram(b_global, f_text, num_iterations)
             if use_gram is None
             else use_gram
         )
         if gram:
+            numeric = batch.numeric.astype(dtype)
             # ``raw`` above is u = Z·W_prev — the dual loop starts from it
-            return _gram_sgd(weights, batch, raw, mask, labels), StepOutput(
+            local_args = (batch.token_idx, batch.token_val, numeric)
+            row_args = local_args + (raw, mask, labels)
+            if axis_name:
+                # ONE all-gather of the batch; the loop runs replicated and
+                # collective-free (vs a gradient psum per iteration below)
+                row_args = tuple(
+                    lax.all_gather(a, axis_name, axis=0, tiled=True)
+                    for a in row_args
+                )
+            return _gram_sgd(weights, row_args, local_args), StepOutput(
                 predictions=preds, **stats
             )
 
@@ -352,6 +455,7 @@ class StreamingSGDModel:
         convergence_tol: float = 0.001,
         dtype=jnp.float32,
         use_sparse: bool | None = None,
+        use_gram: bool | None = None,
     ) -> None:
         self.num_text_features = num_text_features
         self.dtype = dtype
@@ -367,6 +471,7 @@ class StreamingSGDModel:
             prediction_fn=type(self).prediction_fn,
             round_predictions=self.round_predictions,
             use_sparse=use_sparse,
+            use_gram=use_gram,  # None=auto; False is the scatter-loop escape hatch
         )
         # donate weights: the update happens in-place in HBM
         self._step = jax.jit(step, donate_argnums=0)

@@ -104,17 +104,20 @@ def onehot_counts(token_idx, token_val, f_text: int, dtype=jnp.bfloat16):
     return c.reshape(b, k_hi * k_lo)[:, :f_text]
 
 
-def gram_matrix(token_idx, token_val, numeric, f_text: int):
-    """G = Z·Zᵀ ([B,B] f32) for Z = [text counts | numeric features].
+def text_gram(token_idx, token_val, f_text: int, row_start=None, rows: int = 0):
+    """Text-feature Gram block: X·Xᵀ ([B,B] f32), or the row slice
+    ``X[row_start:row_start+rows]·Xᵀ`` ([rows, B]) when ``rows`` > 0 — the
+    building block sharded layouts use (each shard computes its row panel
+    and/or its feature slice's partial G, then all-gathers/psums).
 
     Common path (every real tweet): token values are small integers and each
     row's total token mass is ≤ 255, which PROVES every count is an integer
     ≤ 255 and therefore bf16-exact — so the count matrix is built by the
-    one-hot matmul straight into bf16 and G is one bf16×bf16→f32 MXU matmul.
-    The predicate costs one pass over the [B, L] token values (not the
-    [B, F] counts). Anything else — fractional values, a degenerate row with
-    > 255 mass — takes the exact fallback: f32 scatter densify + full-f32
-    (``Precision.HIGHEST``) matmul.
+    one-hot matmul straight into bf16 and the product is one bf16×bf16→f32
+    MXU matmul. The predicate costs one pass over the [B, L] token values
+    (not the [B, F] counts). Anything else — fractional values, a degenerate
+    row with > 255 mass — takes the exact fallback: f32 scatter densify +
+    full-f32 (``Precision.HIGHEST``) matmul.
     """
     val_f = token_val.astype(jnp.float32)
     # integral, bf16-representable values with row ABSOLUTE mass ≤ 255 ⇒
@@ -127,17 +130,28 @@ def gram_matrix(token_idx, token_val, numeric, f_text: int):
         & jnp.all(jnp.sum(jnp.abs(val_f), axis=1) <= 255.0)
     )
 
+    def left(c):
+        """The (possibly row-sliced) left operand; the slice makes the
+        matmul FLOPs scale 1/shards in sharded builds."""
+        if rows:
+            return lax.dynamic_slice_in_dim(c, row_start, rows, axis=0)
+        return c
+
     def fast(i, v):
         c = onehot_counts(i, v, f_text)  # [B, F] bf16, exact
-        return jnp.matmul(c, c.T, preferred_element_type=jnp.float32)
+        return jnp.matmul(left(c), c.T, preferred_element_type=jnp.float32)
 
     def exact(i, v):
         c = densify_text(i, v, f_text)  # [B, F] f32
-        return jnp.matmul(c, c.T, precision=lax.Precision.HIGHEST)
+        return jnp.matmul(left(c), c.T, precision=lax.Precision.HIGHEST)
 
-    g_text = lax.cond(vals_ok, fast, exact, token_idx, val_f)
+    return lax.cond(vals_ok, fast, exact, token_idx, val_f)
+
+
+def gram_matrix(token_idx, token_val, numeric, f_text: int):
+    """G = Z·Zᵀ ([B,B] f32) for Z = [text counts | numeric features]."""
     num = numeric.astype(jnp.float32)
-    return g_text + num @ num.T
+    return text_gram(token_idx, token_val, f_text) + num @ num.T
 
 
 def dual_norm_sq(p_prev, u, g):

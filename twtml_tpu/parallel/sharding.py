@@ -32,7 +32,14 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..features.batch import NUM_NUMBER_FEATURES, FeatureBatch, UnitBatch
 from ..models.base import StepOutput
-from ..models.sgd import make_sgd_train_step, sampling_key, sgd_inner_loop
+from ..models.sgd import (
+    dual_scale_and_alpha,
+    make_sgd_train_step,
+    run_dual_loop,
+    sampling_key,
+    sgd_inner_loop,
+)
+from ..ops.gram import fits_gram, text_gram
 from ..ops.sparse import sparse_grad_text, sparse_text_dot
 from ..ops.stats import batch_stats
 from ..ops.text_hash import hash_bigrams_device
@@ -94,10 +101,22 @@ def _make_feature_sharded_step(
     round_predictions: bool,
     data_axis: str,
     model_axis: str,
+    use_gram: bool | None = None,
 ):
     """Per-shard body for the 2D (data × model) mesh. Weights arrive as a
     {'text': [f_text_local], 'num': [4]} pytree; token indices are global and
-    each shard contributes only the tokens landing in its slice."""
+    each shard contributes only the tokens landing in its slice.
+
+    The inner loop runs in the Gram (dual) basis whenever it applies (f32
+    weights, per-shard dense counts within HBM budget — ops/gram.py): one
+    all-gather of the batch over ``data``, each shard's feature slice
+    contributes its partial G row panel (psum over ``model``), one
+    all-gather over ``data`` replicates G, and the [B]-sized dual loop runs
+    replicated with ZERO per-iteration collectives — versus one predict
+    psum over ``model`` plus one gradient psum over ``data`` per iteration
+    (2·numIterations collectives/batch) in the scatter formulation. The
+    write-back stays slice-local (this shard's rows × its feature slice)
+    with one psum over ``data``."""
     residual_fn = residual_fn or (lambda raw, label: raw - label)
     prediction_fn = prediction_fn or (lambda raw: raw)
 
@@ -128,10 +147,69 @@ def _make_feature_sharded_step(
             return lax.psum(part, model_axis) + numeric @ w["num"]
 
         # ---- predict + stats with pre-update weights --------------------
-        preds = prediction_fn(predict(weights))
+        raw = predict(weights)
+        preds = prediction_fn(raw)
         if round_predictions:
             preds = jnp_round_half_up(preds)
         stats = batch_stats(labels, preds, mask, data_axis)
+
+        # ---- Gram (dual) basis when it applies (see docstring) ----------
+        b_local = mask.shape[0]
+        b_global = b_local * lax.axis_size(data_axis)
+        gram = (
+            dtype == jnp.float32
+            and fits_gram(b_global, f_text_local, num_iterations)
+            if use_gram is None
+            else use_gram
+        )
+        if gram:
+            gather = lambda a: lax.all_gather(a, data_axis, axis=0, tiled=True)
+            idx_g, val_g, num_g, lab_g, mask_g, u = map(
+                gather, (g_idx, token_val, numeric, labels, mask, raw)
+            )
+            rel_g = idx_g - lo
+            in_g = ((rel_g >= 0) & (rel_g < f_text_local)).astype(dtype)
+            panel = text_gram(
+                jnp.clip(rel_g, 0, f_text_local - 1),
+                val_g * in_g,
+                f_text_local,
+                row_start=lax.axis_index(data_axis) * b_local,
+                rows=b_local,
+            )  # [B_local, B_global] partial over this feature slice
+            g_mat = lax.all_gather(
+                lax.psum(panel, model_axis), data_axis, axis=0, tiled=True
+            )
+            num32 = num_g.astype(jnp.float32)
+            g_mat = (g_mat + num32 @ num32.T).astype(dtype)
+
+            dual = run_dual_loop(
+                u=u,
+                g=g_mat,
+                labels=lab_g,
+                mask=mask_g,
+                dtype=dtype,
+                residual_fn=residual_fn,
+                num_iterations=num_iterations,
+                step_size=step_size,
+                mini_batch_fraction=mini_batch_fraction,
+                l2_reg=l2_reg,
+                convergence_tol=convergence_tol,
+                p_prev=lax.psum(jnp.sum(w_text * w_text), model_axis)
+                + jnp.sum(w_num * w_num),
+                vary_axis=data_axis,
+            )
+            # psum-mean of the (identical-everywhere) scale + psum of the
+            # slice-local write-back: statically invariant over ``data``
+            c, alpha_local = dual_scale_and_alpha(dual, data_axis, b_local)
+            delta_text = lax.psum(
+                sparse_grad_text(rel, local_val, alpha_local, f_text_local),
+                data_axis,
+            )
+            w_final = {
+                "text": w_text * c + delta_text,
+                "num": w_num * c + lax.psum(numeric.T @ alpha_local, data_axis),
+            }
+            return w_final, StepOutput(predictions=preds, **stats)
 
         # ---- the shared MLlib iteration loop over the sharded pytree ----
         def grad_and_count(w, sel):
@@ -184,6 +262,7 @@ class ParallelSGDModel:
         prediction_fn: Callable | None = None,
         round_predictions: bool = True,
         use_sparse: bool | None = None,
+        use_gram: bool | None = None,
     ) -> None:
         self.mesh = mesh
         self.num_text_features = num_text_features
@@ -208,6 +287,7 @@ class ParallelSGDModel:
                 round_predictions=round_predictions,
                 axis_name=self.data_axis,
                 use_sparse=use_sparse,
+                use_gram=use_gram,
             )
             self._weights = jnp.zeros(
                 (num_text_features + NUM_NUMBER_FEATURES,), dtype
@@ -233,6 +313,7 @@ class ParallelSGDModel:
                 round_predictions=round_predictions,
                 data_axis=self.data_axis,
                 model_axis=self.model_axis,
+                use_gram=use_gram,
             )
             self._weights = {
                 "text": jax.device_put(
