@@ -102,7 +102,21 @@ def main() -> None:
             "weights": np.asarray(resumed.latest_weights).tolist(),
         }), flush=True)
         return
-    if mesh_kind == "2d":
+    if mesh_kind == "2d_gram":
+        # the Gram (dual) inner loop with BOTH of its collectives crossing
+        # process boundaries: the batch all-gather over 'data' and the G
+        # panel psum over 'model' (device order pairs processes on the model
+        # axis, as in '2d' below). Must match the dense single-process math.
+        d = jax.devices()
+        mesh = make_mesh(
+            num_data=2, num_model=2, devices=[d[0], d[2], d[1], d[3]]
+        )
+        model = ParallelSGDModel(
+            mesh, num_text_features=1000, num_iterations=5, step_size=0.005,
+            use_sparse=True, use_gram=True,
+        )
+        global_batch = shard_batch(featurize(statuses), mesh)
+    elif mesh_kind == "2d":
         # arrange devices so the MODEL axis pairs devices from DIFFERENT
         # processes: jax.devices() is process-major [p0d0,p0d1,p1d0,p1d1];
         # ordering [p0d0,p1d0,p0d1,p1d1] makes each mesh row mix processes —

@@ -160,3 +160,16 @@ def test_two_process_2d_mesh_feature_sharding():
     count, mse, weights = _single_process_expectation("unit")
     assert outs[0]["mse"] == pytest.approx(mse, rel=1e-4)
     np.testing.assert_allclose(outs[0]["weights"], weights, rtol=1e-4, atol=1e-7)
+
+
+def test_two_process_2d_mesh_gram_inner_loop():
+    """The Gram (dual) inner loop with both of its per-batch collectives
+    crossing REAL process boundaries — the batch all-gather over 'data' and
+    the G row-panel psum over 'model' (models/sgd.py run_dual_loop,
+    parallel/sharding.py) — still matches the single-process dense math."""
+    outs = _run_group("unit", mesh="2d_gram")
+    assert outs[0]["count"] == outs[1]["count"] == 64.0
+    np.testing.assert_allclose(outs[0]["weights"], outs[1]["weights"], rtol=1e-6)
+    _, mse, weights = _single_process_expectation("unit")
+    assert outs[0]["mse"] == pytest.approx(mse, rel=1e-4)
+    np.testing.assert_allclose(outs[0]["weights"], weights, rtol=1e-4, atol=1e-6)

@@ -34,7 +34,14 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..features.batch import NUM_NUMBER_FEATURES, FeatureBatch, UnitBatch
-from ..ops.gram import dual_norm_sq, dual_writeback, fits_gram, text_gram
+from ..ops.gram import (
+    add_numeric_block,
+    dual_norm_sq,
+    dual_writeback,
+    fits_gram,
+    gram_matrix,
+    text_gram,
+)
 from ..ops.sparse import densify_text, sparse_grad_text, sparse_predict
 from ..ops.stats import batch_stats
 from ..ops.text_hash import hash_bigrams_device
@@ -299,10 +306,9 @@ def make_sgd_train_step(
                 rows=rows,
             )  # [B_local, B_global]: FLOPs scale 1/shards
             g_text = lax.all_gather(panel, axis_name, axis=0, tiled=True)
+            g = add_numeric_block(g_text, numeric, dtype)
         else:
-            g_text = text_gram(token_idx, token_val, f_text)
-        num32 = numeric.astype(jnp.float32)
-        g = (g_text + num32 @ num32.T).astype(dtype)
+            g = gram_matrix(token_idx, token_val, numeric, f_text, dtype)
 
         dual = run_dual_loop(
             u=u,

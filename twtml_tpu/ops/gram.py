@@ -148,10 +148,17 @@ def text_gram(token_idx, token_val, f_text: int, row_start=None, rows: int = 0):
     return lax.cond(vals_ok, fast, exact, token_idx, val_f)
 
 
-def gram_matrix(token_idx, token_val, numeric, f_text: int):
-    """G = Z·Zᵀ ([B,B] f32) for Z = [text counts | numeric features]."""
+def add_numeric_block(g_text, numeric, dtype=jnp.float32):
+    """G = g_text + N·Nᵀ, cast to the dual loop's dtype — the one place the
+    numeric features enter G (shared by every layout so precision handling
+    cannot drift between them)."""
     num = numeric.astype(jnp.float32)
-    return text_gram(token_idx, token_val, f_text) + num @ num.T
+    return (g_text + num @ num.T).astype(dtype)
+
+
+def gram_matrix(token_idx, token_val, numeric, f_text: int, dtype=jnp.float32):
+    """G = Z·Zᵀ ([B,B] ``dtype``) for Z = [text counts | numeric features]."""
+    return add_numeric_block(text_gram(token_idx, token_val, f_text), numeric, dtype)
 
 
 def dual_norm_sq(p_prev, u, g):

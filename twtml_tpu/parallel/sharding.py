@@ -39,7 +39,7 @@ from ..models.sgd import (
     sampling_key,
     sgd_inner_loop,
 )
-from ..ops.gram import fits_gram, text_gram
+from ..ops.gram import add_numeric_block, fits_gram, text_gram
 from ..ops.sparse import sparse_grad_text, sparse_text_dot
 from ..ops.stats import batch_stats
 from ..ops.text_hash import hash_bigrams_device
@@ -179,8 +179,7 @@ def _make_feature_sharded_step(
             g_mat = lax.all_gather(
                 lax.psum(panel, model_axis), data_axis, axis=0, tiled=True
             )
-            num32 = num_g.astype(jnp.float32)
-            g_mat = (g_mat + num32 @ num32.T).astype(dtype)
+            g_mat = add_numeric_block(g_mat, num_g, dtype)
 
             dual = run_dual_loop(
                 u=u,
