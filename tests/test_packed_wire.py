@@ -1,0 +1,100 @@
+"""The one-buffer wire format (features/batch.py PackedBatch): host pack →
+device bitcast unpack must be bit-identical for every field and dtype the
+batch types ship (uint8/uint16 units, int16/int32 indices, uint16 counts,
+float32), and a model fed packed batches must produce bitwise-identical
+trajectories to one fed the plain arrays — packing changes transfer count,
+never semantics."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from twtml_tpu.features.batch import (
+    FeatureBatch,
+    UnitBatch,
+    pack_batch,
+    unpack_batch,
+)
+from twtml_tpu.features.featurizer import Featurizer
+from twtml_tpu.models import StreamingLinearRegressionWithSGD
+from twtml_tpu.streaming.sources import SyntheticSource
+
+
+def unit_batch(ascii_only=True):
+    rng = np.random.default_rng(0)
+    dtype = np.uint8 if ascii_only else np.uint16
+    units = rng.integers(32, 127 if ascii_only else 0x3FF, size=(16, 24)).astype(dtype)
+    return UnitBatch(
+        units,
+        rng.integers(0, 24, size=(16,)).astype(np.int32),
+        rng.normal(size=(16, 4)).astype(np.float32),
+        rng.uniform(0, 1000, size=(16,)).astype(np.float32),
+        (rng.uniform(size=(16,)) < 0.9).astype(np.float32),
+    )
+
+
+def feature_batch(narrow=True):
+    rng = np.random.default_rng(1)
+    idx_t = np.int16 if narrow else np.int32
+    val_t = np.uint16 if narrow else np.float32
+    return FeatureBatch(
+        rng.integers(0, 1000, size=(16, 8)).astype(idx_t),
+        rng.integers(0, 4, size=(16, 8)).astype(val_t),
+        rng.normal(size=(16, 4)).astype(np.float32),
+        rng.uniform(0, 1000, size=(16,)).astype(np.float32),
+        np.ones((16,), np.float32),
+    )
+
+
+def assert_roundtrip(batch):
+    packed = pack_batch(batch)
+    # host roundtrip
+    host = unpack_batch(packed.buffer, packed.layout)
+    for a, b in zip(batch, host):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+    # device roundtrip (bitcast path inside jit)
+    dev = jax.jit(lambda buf: tuple(unpack_batch(buf, packed.layout)))(
+        jnp.asarray(packed.buffer)
+    )
+    for a, b in zip(batch, dev):
+        assert np.dtype(a.dtype) == np.dtype(b.dtype)
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+
+def test_roundtrip_unit_ascii():
+    assert_roundtrip(unit_batch(ascii_only=True))
+
+
+def test_roundtrip_unit_wide():
+    assert_roundtrip(unit_batch(ascii_only=False))
+
+
+def test_roundtrip_feature_narrow():
+    assert_roundtrip(feature_batch(narrow=True))
+
+
+def test_roundtrip_feature_wide():
+    assert_roundtrip(feature_batch(narrow=False))
+
+
+def test_model_trajectory_bitwise_identical():
+    """Real featurized stream through the flagship model: explicitly packed
+    wire vs plain arrays — identical mse sequence and final weights, bit
+    for bit."""
+    statuses = list(SyntheticSource(total=96, seed=3, base_ms=1785320000000).produce())
+    feat = Featurizer(now_ms=1785320000000)
+    chunks = [statuses[i : i + 32] for i in range(0, 96, 32)]
+    batches = [
+        feat.featurize_batch_units(c, row_bucket=32, pre_filtered=True)
+        for c in chunks
+    ]
+
+    m_packed = StreamingLinearRegressionWithSGD(num_iterations=10)
+    m_plain = StreamingLinearRegressionWithSGD(num_iterations=10)
+    for b in batches:
+        out_p = m_packed.step(pack_batch(b))  # opt-in one-buffer wire
+        out_q = m_plain.step(b)
+        assert float(out_p.mse) == float(out_q.mse)
+    np.testing.assert_array_equal(m_packed.latest_weights, m_plain.latest_weights)
