@@ -33,7 +33,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..features.batch import NUM_NUMBER_FEATURES, FeatureBatch, UnitBatch
+from ..features.batch import (
+    NUM_NUMBER_FEATURES,
+    FeatureBatch,
+    PackedBatch,
+    UnitBatch,
+    unpack_batch,
+)
 from ..ops.gram import (
     add_numeric_block,
     dual_norm_sq,
@@ -347,8 +353,12 @@ def make_sgd_train_step(
             )
         return jnp.concatenate([w_text_new, w_num_new])
 
-    def train_step(weights, batch: FeatureBatch | UnitBatch):
+    def train_step(weights, batch: FeatureBatch | UnitBatch | PackedBatch):
         dtype = weights.dtype
+        if isinstance(batch, PackedBatch):
+            # one-buffer wire format: reinterpret in-place (features/batch.py
+            # PackedBatch — bit-identical arrays, transfer-count 5 → 1)
+            batch = unpack_batch(batch.buffer, batch.layout)
         if isinstance(batch, UnitBatch):
             # on-device featurization: hash the raw code units inside this
             # same XLA program (ops/text_hash.py); per-occurrence 1.0 values
@@ -511,8 +521,15 @@ class StreamingSGDModel:
 
         return np.asarray(self._weights)
 
-    def step(self, batch: FeatureBatch | UnitBatch) -> StepOutput:
-        """Fused predict-then-train on one micro-batch; advances the model."""
+    def step(self, batch: FeatureBatch | UnitBatch | PackedBatch) -> StepOutput:
+        """Fused predict-then-train on one micro-batch; advances the model.
+
+        Accepts the one-buffer wire format too (``pack_batch``) — bit-
+        identical unpack inside the jit step. NOT applied by default: on
+        this build's transport the multi-array overhead hides behind
+        overlapped dispatch in every real regime (measured — BENCHMARKS.md
+        "negative results"), so packing is an explicit opt-in for transports
+        where per-transfer cost is exposed."""
         self._weights, out = self._step(self._weights, batch)
         return out
 
