@@ -32,6 +32,11 @@ def test_sharded_dp4_runs_on_virtual_mesh():
     assert rec["tweets_per_sec"] > 0
 
 
+def test_sharded_2e18_2d_runs_on_virtual_mesh():
+    rec = bench_suite.run_config("sharded_2e18_2d", 256, 128)
+    assert rec["tweets_per_sec"] > 0
+
+
 def test_twitter_live_skips_without_creds():
     rec = bench_suite.run_config("twitter_live", 64, 64)
     assert "skipped" in rec

@@ -10,6 +10,7 @@ counts > 255 and non-integral token values."""
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from twtml_tpu.features.batch import NUM_NUMBER_FEATURES, FeatureBatch, UnitBatch
@@ -324,6 +325,30 @@ def test_feature_sharded_gram_vs_scatter():
     np.testing.assert_allclose(
         m_gram.latest_weights, m_scat.latest_weights, rtol=2e-4, atol=2e-4
     )
+
+
+def test_full_scale_2e18_gram_matches_scatter():
+    """Both formulations at the REAL feature width (2^18) through the
+    default wire format (units → device hash): the full-scale shapes the
+    bench runs, pinned to each other."""
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.streaming.sources import SyntheticSource
+
+    statuses = list(
+        SyntheticSource(total=48, seed=3, base_ms=1785320000000).produce()
+    )
+    feat = Featurizer(num_text_features=2**18, now_ms=1785320000000)
+    batch = feat.featurize_batch_units(statuses, row_bucket=48, pre_filtered=True)
+    kw = dict(
+        num_text_features=2**18, num_iterations=5, step_size=0.005, l2_reg=0.1
+    )
+    w0 = zero_weights(2**18)
+    scatter = make_sgd_train_step(use_gram=False, **kw)
+    gram = make_sgd_train_step(use_gram=True, **kw)
+    w_s, out_s = jax.jit(scatter)(w0, batch)
+    w_g, out_g = jax.jit(gram)(w0, batch)
+    assert float(out_g.mse) == float(out_s.mse)
+    np.testing.assert_allclose(np.asarray(w_g), np.asarray(w_s), rtol=1e-4, atol=1e-7)
 
 
 def test_auto_gate_picks_gram_only_when_it_fits():

@@ -17,6 +17,10 @@ numbers, so every baseline is measured, not copied):
   6. sharded_dp4_logistic — the logistic learner on the same 4-way mesh
                          (sentiment labels; non-least-squares residual
                          through the sharded step)
+  7. sharded_2e18_2d   — config #4's 2^18 feature space on the 2D
+                         (data × model) mesh: feature-sharded weights, the
+                         Gram dual loop's per-batch collective schedule
+                         (SURVEY §5.7's long-context analog, distributed)
 
 Each config runs in its own subprocess (clean jax backend state) and prints
 one JSON line: {"config", "tweets_per_sec", "seconds", "batches", "final_metric",
@@ -43,6 +47,7 @@ CONFIGS = [
     "hashing_2e18_l2",
     "sharded_dp4",
     "sharded_dp4_logistic",
+    "sharded_2e18_2d",
 ]
 
 
@@ -270,21 +275,29 @@ def run_config(name: str, n_tweets: int, batch_size: int) -> dict:
             num_text_features=2**18, l2_reg=0.1
         )
         out.update(_pipeline_rate(model, feat, statuses, batch_size))
-    elif name in ("sharded_dp4", "sharded_dp4_logistic"):
+    elif name in ("sharded_dp4", "sharded_dp4_logistic", "sharded_2e18_2d"):
         from twtml_tpu.parallel import ParallelSGDModel, make_mesh
         from twtml_tpu.parallel.sharding import shard_batch
 
         if len(jax.devices()) < 4:
             return {**out, "skipped": "backend initialized with <4 devices"}
-        mesh = make_mesh(num_data=4, devices=jax.devices()[:4])
-        feat = Featurizer(now_ms=1785320000000)
-        if name == "sharded_dp4_logistic":
+        # per-config mesh shape / feature width; data-axis size sets the
+        # row_multiple every padded batch must divide by
+        num_data, num_model = (2, 2) if name == "sharded_2e18_2d" else (4, 1)
+        mesh = make_mesh(
+            num_data=num_data, num_model=num_model, devices=jax.devices()[:4]
+        )
+        if name == "sharded_2e18_2d":
+            feat = Featurizer(num_text_features=2**18, now_ms=1785320000000)
+            model = ParallelSGDModel(mesh, num_text_features=2**18, l2_reg=0.1)
+        elif name == "sharded_dp4_logistic":
             from twtml_tpu.features.sentiment import (
                 sentiment_label,
                 sentiment_labels,
             )
             from twtml_tpu.models import StreamingLogisticRegressionWithSGD as LR
 
+            feat = Featurizer(now_ms=1785320000000)
             feat.label_fn = sentiment_label
             feat.batch_label_fn = sentiment_labels
             model = ParallelSGDModel(
@@ -293,11 +306,12 @@ def run_config(name: str, n_tweets: int, batch_size: int) -> dict:
                 round_predictions=LR.round_predictions,
             )
         else:
+            feat = Featurizer(now_ms=1785320000000)
             model = ParallelSGDModel(mesh)
         out.update(
             _pipeline_rate(
                 model, feat, statuses, batch_size,
-                row_multiple=4, shard=lambda b: shard_batch(b, mesh),
+                row_multiple=num_data, shard=lambda b: shard_batch(b, mesh),
             )
         )
     else:
