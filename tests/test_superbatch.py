@@ -1,0 +1,234 @@
+"""Superbatch ingest (``step_many``): a lax.scan of K micro-batch steps in
+one dispatch must be the SAME computation as K sequential ``step`` calls —
+identical final weights and per-batch stats (the scan body is the very same
+train_step program, weights chained through it) — for the dense path, the
+sparse Gram path, and the logistic residual."""
+
+import numpy as np
+
+from twtml_tpu.features.batch import stack_batches
+from twtml_tpu.features.featurizer import Featurizer
+from twtml_tpu.models import (
+    StreamingLinearRegressionWithSGD,
+    StreamingLogisticRegressionWithSGD,
+)
+from twtml_tpu.streaming.sources import SyntheticSource
+
+
+def featurized_batches(n=4, rows=32, f_text=None):
+    statuses = list(
+        SyntheticSource(total=n * rows, seed=3, base_ms=1785320000000).produce()
+    )
+    feat = Featurizer(now_ms=1785320000000, **(
+        {"num_text_features": f_text} if f_text else {}
+    ))
+    return [
+        feat.featurize_batch_units(
+            statuses[i * rows : (i + 1) * rows], row_bucket=rows, pre_filtered=True
+        )
+        for i in range(n)
+    ]
+
+
+def assert_equivalent(make_model, batches):
+    seq = make_model()
+    outs = [seq.step(b) for b in batches]
+    sup = make_model()
+    stacked_out = sup.step_many(stack_batches(batches))
+    np.testing.assert_array_equal(sup.latest_weights, seq.latest_weights)
+    for k, out in enumerate(outs):
+        assert float(stacked_out.mse[k]) == float(out.mse)
+        assert float(stacked_out.count[k]) == float(out.count)
+        np.testing.assert_array_equal(
+            np.asarray(stacked_out.predictions[k]), np.asarray(out.predictions)
+        )
+
+
+def test_dense_superbatch_matches_sequential():
+    assert_equivalent(
+        lambda: StreamingLinearRegressionWithSGD(num_iterations=10),
+        featurized_batches(),
+    )
+
+
+def test_sparse_gram_superbatch_matches_sequential():
+    assert_equivalent(
+        lambda: StreamingLinearRegressionWithSGD(
+            num_text_features=2**14, num_iterations=5, l2_reg=0.1
+        ),
+        featurized_batches(f_text=2**14),
+    )
+
+
+def test_logistic_superbatch_matches_sequential():
+    from twtml_tpu.features.sentiment import sentiment_label, sentiment_labels
+
+    statuses = list(SyntheticSource(total=96, seed=5, base_ms=1785320000000).produce())
+    feat = Featurizer(now_ms=1785320000000)
+    feat.label_fn = sentiment_label
+    feat.batch_label_fn = sentiment_labels
+    batches = [
+        feat.featurize_batch_units(statuses[i : i + 32], row_bucket=32, pre_filtered=True)
+        for i in range(0, 96, 32)
+    ]
+    assert_equivalent(
+        lambda: StreamingLogisticRegressionWithSGD(num_iterations=10), batches
+    )
+
+
+def test_linear_app_superbatch_identical_stats(tmp_path, capsys):
+    """The flagship app with --superBatch 3 prints the IDENTICAL per-batch
+    stats lines (same batch boundaries, same mse/stdev sequence) and ends
+    with identical weights as the plain run — including the partial final
+    group drained by the termination flush."""
+    import json as _json
+
+    from twtml_tpu.apps import linear_regression as app
+    from twtml_tpu.config import ConfArguments
+
+    path = tmp_path / "tweets.jsonl"
+    statuses = list(SyntheticSource(total=7 * 16, seed=9, base_ms=1785320000000).produce())
+    from tools.bench_suite import _status_json
+
+    with open(path, "w") as fh:
+        for s in statuses:
+            fh.write(_json.dumps(_status_json(s)) + "\n")
+
+    def run(extra):
+        conf = ConfArguments().parse(
+            [
+                "--source", "replay", "--replayFile", str(path),
+                "--seconds", "0", "--backend", "cpu",
+                "--batchBucket", "16", "--tokenBucket", "64",
+                "--master", "local[1]",  # single-device learner: step_many
+            ]
+            + extra
+        )
+        capsys.readouterr()
+        totals = app.run(conf)
+        lines = [
+            ln for ln in capsys.readouterr().out.splitlines()
+            if ln.startswith("count:")
+        ]
+        return totals, lines
+
+    totals_plain, lines_plain = run([])
+    totals_super, lines_super = run(["--superBatch", "3"])
+    assert totals_super == totals_plain
+    assert lines_super == lines_plain
+    assert len(lines_plain) >= 5  # several batches incl. a partial group
+
+
+def test_superbatch_requires_pinned_buckets(tmp_path):
+    from twtml_tpu.apps import linear_regression as app
+    from twtml_tpu.config import ConfArguments
+
+    path = tmp_path / "tweets.jsonl"
+    path.write_text("")
+    conf = ConfArguments().parse(
+        [
+            "--source", "replay", "--replayFile", str(path),
+            "--seconds", "0", "--backend", "cpu", "--superBatch", "4",
+            "--master", "local[1]",
+        ]
+    )
+    import pytest
+
+    with pytest.raises(ValueError, match="superBatch needs pinned shapes"):
+        app.run(conf)
+
+
+def test_mixed_shape_batches_flush_not_drop():
+    """A batch with a different shape (bucket overflow / units dtype flip)
+    must close the pending group and form its own — every batch trains,
+    none is dropped, order preserved."""
+    from twtml_tpu.apps.common import SuperBatcher
+
+    small = featurized_batches(n=5, rows=16)
+    big = featurized_batches(n=1, rows=32)[0]
+    stream = [small[0], small[1], big, small[2], small[3], small[4]]
+
+    model = StreamingLinearRegressionWithSGD(num_iterations=5)
+    seen = []
+    batcher = SuperBatcher(
+        model, 2, lambda out, batch, t, at_boundary: seen.append(
+            (batch.mask.shape[0], float(out.count))
+        )
+    )
+    for i, b in enumerate(stream):
+        batcher.on_batch(b, float(i))
+    batcher.flush()
+    assert [rows for rows, _ in seen] == [16, 16, 32, 16, 16, 16]
+
+    ref = StreamingLinearRegressionWithSGD(num_iterations=5)
+    for b in stream:
+        ref.step(b)
+    np.testing.assert_array_equal(model.latest_weights, ref.latest_weights)
+
+
+def test_partial_tail_uses_plain_steps():
+    """Group sizes below K run as plain steps — no scanned program is built
+    for one-off lengths."""
+    from twtml_tpu.apps.common import SuperBatcher
+
+    batches = featurized_batches(n=3)
+    model = StreamingLinearRegressionWithSGD(num_iterations=5)
+    emitted = []
+    b4 = SuperBatcher(model, 4, lambda o, b, t, at_boundary: emitted.append(o))
+    for i, b in enumerate(batches):
+        b4.on_batch(b, float(i))
+    b4.flush()
+    assert len(emitted) == 3
+    assert model._scan_step is None  # never compiled a scan
+
+    ref = StreamingLinearRegressionWithSGD(num_iterations=5)
+    for b in batches:
+        ref.step(b)
+    np.testing.assert_array_equal(model.latest_weights, ref.latest_weights)
+
+
+def test_checkpoint_cadence_crosses_group_boundaries(tmp_path):
+    """--checkpointEvery E with --superBatch K saves on the first boundary
+    at/after each cadence point (not lcm(K, E))."""
+    import json as _json
+
+    from twtml_tpu.apps import linear_regression as app
+    from twtml_tpu.config import ConfArguments
+
+    path = tmp_path / "tweets.jsonl"
+    statuses = list(SyntheticSource(total=8 * 16, seed=9, base_ms=1785320000000).produce())
+    from tools.bench_suite import _status_json
+
+    with open(path, "w") as fh:
+        for s in statuses:
+            fh.write(_json.dumps(_status_json(s)) + "\n")
+    ckdir = tmp_path / "ck"
+    conf = ConfArguments().parse(
+        [
+            "--source", "replay", "--replayFile", str(path),
+            "--seconds", "0", "--backend", "cpu",
+            "--batchBucket", "16", "--tokenBucket", "64",
+            "--master", "local[1]", "--superBatch", "3",
+            "--checkpointDir", str(ckdir), "--checkpointEvery", "2",
+        ]
+    )
+    app.run(conf)
+    from twtml_tpu.checkpoint import Checkpointer
+
+    weights, meta = Checkpointer(str(ckdir)).restore()
+    # 8 batches in groups of 3: boundaries at 3, 6, 8(flush); cadence 2 →
+    # saves at 3, 6, 8 — the final state is checkpointed
+    assert meta["batches"] == 8
+
+
+def test_cumulative_count_chains_across_stream():
+    """step_many is stateful like step: a second call continues the same
+    model (weights advance, no reset between superbatches)."""
+    batches = featurized_batches(n=4)
+    seq = StreamingLinearRegressionWithSGD(num_iterations=5)
+    for b in batches:
+        seq.step(b)
+    sup = StreamingLinearRegressionWithSGD(num_iterations=5)
+    sup.step_many(stack_batches(batches[:2]))
+    sup.step_many(stack_batches(batches[2:]))
+    np.testing.assert_array_equal(sup.latest_weights, seq.latest_weights)

@@ -162,7 +162,146 @@ def build_model(conf, model_cls=StreamingLinearRegressionWithSGD):
     return model_cls.from_conf(conf), 1
 
 
-def warmup_compile(stream, model) -> None:
+class SuperBatcher:
+    """Group K featurized micro-batches into ONE device dispatch
+    (``model.step_many``: a lax.scan of the ordinary train step) and re-emit
+    each batch's StepOutput to ``handle`` in order.
+
+    Why: in replay/back-to-back regimes every per-batch stats fetch costs a
+    full transport round trip (~100 ms through this build's TPU tunnel —
+    BENCHMARKS.md), capping the telemetry-on path at ~17k tweets/s; fetching
+    K batches' stats as one array lifts that ~K× (measured ~17k → ~100k at
+    K=8, batch 2048). Semantics are unchanged: batch boundaries, per-batch
+    stats, predict-then-train ordering, and final weights are bitwise those
+    of K sequential ``step`` calls (tests/test_superbatch.py). Requires
+    pinned batch buckets (every grouped batch must share one shape).
+
+    ``handle(out, batch, batch_time)`` receives plain-numpy per-batch
+    outputs; call ``flush()`` after the stream terminates to drain a
+    partial final group.
+
+    Only contiguous SAME-SHAPE batches group (one compiled scan program): a
+    batch that overflowed a pinned bucket, or flipped the units wire dtype,
+    flushes the pending group first and starts its own — it is never
+    silently dropped, and partial groups run as plain steps (identical
+    math, no one-off scan compiles at odd lengths)."""
+
+    def __init__(self, model, k: int, handle):
+        self.model = model
+        self.k = k
+        self.handle = handle
+        self._buf: list = []
+        self._sig = None
+
+    @staticmethod
+    def _signature(batch):
+        return (type(batch),) + tuple((a.shape, a.dtype) for a in batch)
+
+    def on_batch(self, batch, batch_time) -> None:
+        sig = self._signature(batch)
+        if self._buf and sig != self._sig:
+            self.flush()  # shape/dtype changed: close the group, never drop
+        self._sig = sig
+        self._buf.append((batch, batch_time))
+        if len(self._buf) >= self.k:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._buf:
+            return
+        import jax
+
+        from ..features.batch import stack_batches
+        from ..models.base import StepOutput
+
+        group, self._buf = self._buf, []
+        if len(group) < self.k:
+            # partial group (tail, or a shape change): plain steps — the
+            # same math, and no fresh scan compile for a one-off length
+            for batch, t in group:
+                out = jax.device_get(self.model.step(batch))
+                self.handle(out, batch, t, at_boundary=True)
+            return
+        outs = self.model.step_many(stack_batches([b for b, _ in group]))
+        host = jax.device_get(outs)  # ONE transfer for all K batches' stats
+        last = len(group) - 1
+        for k, (batch, t) in enumerate(group):
+            self.handle(
+                StepOutput(*(f[k] for f in host)), batch, t,
+                at_boundary=(k == last),
+            )
+
+
+def attach_super_batcher(conf, stream, model, handle):
+    """Wire the app's per-batch ``handle(out, batch, t, at_boundary)`` to the
+    stream: plain step-then-handle by default, grouped through a
+    SuperBatcher when ``--superBatch K`` applies. Returns
+    ``(flush, effective_k)`` — the app must invoke ``flush`` after
+    termination (drains a partial final group) and may pass ``effective_k``
+    to ``warmup_compile`` so the scan program pre-compiles too.
+
+    ``at_boundary`` is True whenever the model's weights are current as of
+    this batch (always, except mid-group under a superbatch) — the guard for
+    side effects that read ``model.latest_weights``, e.g. checkpoints.
+
+    Group-granular caps: a whole group dispatches as one program, so a
+    ``max_batches``-style stop lands on the first group boundary at/after
+    the cap (up to K−1 extra batches, deterministic — the documented
+    trade of the flag).
+
+    The flag applies only to back-to-back regimes (``--seconds 0``): under a
+    wall clock it would delay live telemetry by K intervals, so it downgrades
+    with a warning. Grouped batches must share one XLA shape, which pinned
+    buckets guarantee — unpinned buckets are an error, matching the
+    pre-compile contract (``warmup_compile``)."""
+    k = int(getattr(conf, "superBatch", 1) or 1)
+    if k > 1 and conf.seconds > 0:
+        log.warning(
+            "--superBatch %d ignored: wall-clock streaming (--seconds %s) "
+            "would delay live stats by %d intervals", k, conf.seconds, k,
+        )
+        k = 1
+    if k > 1 and not hasattr(model, "step_many"):
+        log.warning(
+            "--superBatch %d ignored: %s has no scanned step (mesh-sharded "
+            "learners run per-batch)", k, type(model).__name__,
+        )
+        k = 1
+    if k > 1 and (stream.row_bucket <= 0 or stream.token_bucket <= 0):
+        raise ValueError(
+            "--superBatch needs pinned shapes: set --batchBucket and "
+            "--tokenBucket so every grouped batch compiles to one program"
+        )
+
+    if k <= 1:
+        def per_batch(batch, t):
+            if batch.num_valid == 0:
+                log.debug("batch: 0")
+                return
+            import jax
+
+            # ONE host transfer for the whole StepOutput: the handlers read
+            # every field, and sequential scalar fetches each pay a full
+            # transport round trip (BENCHMARKS.md telemetry regime)
+            out = jax.device_get(model.step(batch))
+            handle(out, batch, t, at_boundary=True)
+
+        stream.foreach_batch(per_batch)
+        return (lambda: None), 1
+
+    batcher = SuperBatcher(model, k, handle)
+
+    def grouped(batch, t):
+        if batch.num_valid == 0:
+            log.debug("batch: 0")
+            return
+        batcher.on_batch(batch, t)
+
+    stream.foreach_batch(grouped)
+    return batcher.flush, k
+
+
+def warmup_compile(stream, model, super_batch: int = 1) -> None:
     """Pre-compile the step for the known batch shape BEFORE the stream
     starts, so the first wall-clock micro-batch doesn't swallow the whole
     compile-time backlog (~30 s on a cold TPU chip, during which a live
@@ -183,12 +322,21 @@ def warmup_compile(stream, model) -> None:
 
     t0 = _time.perf_counter()
     empty = stream.featurize_empty()
-    model.step(empty)
+    variants = [empty]
     if isinstance(empty, UnitBatch) and empty.units.dtype == np.uint8:
         # the units wire dtype is per-batch metadata (uint8 iff every row
         # is ASCII — featurizer._pad_ragged_units): warm BOTH programs so
         # a stream's first non-ASCII tweet doesn't stall mid-flight
-        model.step(empty._replace(units=empty.units.astype(np.uint16)))
+        variants.append(empty._replace(units=empty.units.astype(np.uint16)))
+    for v in variants:
+        model.step(v)
+    if super_batch > 1 and hasattr(model, "step_many"):
+        # --superBatch dispatches a scanned program too: warm it for the
+        # same shapes/dtypes so the first full group doesn't stall
+        from ..features.batch import stack_batches
+
+        for v in variants:
+            model.step_many(stack_batches([v] * super_batch))
     log.info(
         "pre-compiled the train step for buckets (%d, %d) in %.1fs",
         stream.row_bucket, stream.token_bucket, _time.perf_counter() - t0,

@@ -26,6 +26,7 @@ from ..utils import get_logger, round_half_up
 # shared app runtime (apps/common.py); re-exported here because this is the
 # flagship entry other modules historically import the helpers from
 from .common import (  # noqa: F401
+    attach_super_batcher,
     build_model,
     build_source,
     select_backend,
@@ -77,11 +78,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     tracer = Tracer(conf.profileDir)
     last_saved = {"step": totals["batches"]}
 
-    def on_batch(batch, _batch_time) -> None:
-        if batch.num_valid == 0:
-            log.debug("batch: 0")
-            return
-        out = model.step(batch)
+    def handle(out, batch, _batch_time, at_boundary=True) -> None:
         b = int(out.count)
         totals["count"] += b
         totals["batches"] += 1
@@ -100,8 +97,12 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         session.update(
             totals["count"], b, mse, real_stdev, pred_stdev, real, pred
         )
-        if ckpt is not None and conf.checkpointEvery > 0 and (
-            totals["batches"] % conf.checkpointEvery == 0
+        # at_boundary: under --superBatch the weights are only current on
+        # group boundaries — a save lands on the FIRST boundary at/after
+        # each cadence point (crossing test, not modulo: a modulo test
+        # would silently stretch the cadence to lcm(K, checkpointEvery))
+        if ckpt is not None and at_boundary and conf.checkpointEvery > 0 and (
+            totals["batches"] - last_saved["step"] >= conf.checkpointEvery
         ):
             ckpt.save(
                 totals["batches"], model.latest_weights,
@@ -111,9 +112,9 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         if max_batches and totals["batches"] >= max_batches:
             ssc.request_stop()
 
-    stream.foreach_batch(on_batch)
+    flush_group, group_k = attach_super_batcher(conf, stream, model, handle)
 
-    warmup_compile(stream, model)
+    warmup_compile(stream, model, super_batch=group_k)
 
     log.info("Starting the streaming computation...")
     tracer.start()
@@ -124,6 +125,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         pass
     finally:
         ssc.stop()
+        flush_group()  # drain a partial superbatch group before final state
         tracer.stop()
         if ckpt is not None and totals["batches"] != last_saved["step"]:
             ckpt.save(

@@ -28,7 +28,13 @@ from ..models.logistic import StreamingLogisticRegressionWithSGD
 from ..streaming.context import StreamingContext
 from ..telemetry.session_stats import SessionStats
 from ..utils import get_logger, round_half_up
-from .common import build_model, build_source, select_backend, warmup_compile
+from .common import (
+    attach_super_batcher,
+    build_model,
+    build_source,
+    select_backend,
+    warmup_compile,
+)
 
 log = get_logger("apps.logistic")
 
@@ -54,11 +60,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     )
     totals = {"count": 0, "batches": 0}
 
-    def on_batch(batch, _batch_time) -> None:
-        if batch.num_valid == 0:
-            log.debug("batch: 0")
-            return
-        out = model.step(batch)
+    def handle(out, batch, _batch_time, at_boundary=True) -> None:
         b = int(out.count)
         totals["count"] += b
         totals["batches"] += 1
@@ -81,8 +83,8 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         if max_batches and totals["batches"] >= max_batches:
             ssc.request_stop()
 
-    stream.foreach_batch(on_batch)
-    warmup_compile(stream, model)
+    flush_group, group_k = attach_super_batcher(conf, stream, model, handle)
+    warmup_compile(stream, model, super_batch=group_k)
     ssc.start()
     try:
         ssc.await_termination()
@@ -90,6 +92,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         pass
     finally:
         ssc.stop()
+        flush_group()  # drain a partial superbatch group
     return totals
 
 

@@ -133,6 +133,7 @@ class ConfArguments:
         self.checkpointEvery: int = int(conf.get("checkpointEvery", "0"))
         self.profileDir: str = conf.get("profileDir", "")
         self.faultEvery: int = int(conf.get("faultEvery", "0"))
+        self.superBatch: int = int(conf.get("superBatch", "1"))
 
         # Spark-compat knobs: --master/--name are accepted for CLI parity
         # (ConfArguments.scala:95-102); master is interpreted as a backend
@@ -202,6 +203,10 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
   --checkpointEvery <int batches>              Checkpoint cadence. Default: {self.checkpointEvery}
   --profileDir <path>                          Enable jax.profiler traces
   --faultEvery <int tweets>                    Inject a receiver crash every N tweets (chaos testing)
+  --superBatch <int>                           Replay-mode superbatch: K micro-batches per device
+                                               dispatch (one scan, one stats fetch; per-batch
+                                               stats preserved; stops/checkpoints land on group
+                                               boundaries). Default: {self.superBatch}
 """
 
     def parse(self, args: list[str]) -> "ConfArguments":
@@ -277,6 +282,8 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
             self.checkpointEvery = int(take())
         elif flag == "--profileDir":
             self.profileDir = take()
+        elif flag == "--superBatch":
+            self.superBatch = int(take())
         elif flag == "--faultEvery":
             self.faultEvery = int(take())
         elif flag in ("--help", "-h"):

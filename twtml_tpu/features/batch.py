@@ -199,6 +199,19 @@ def unpack_batch(buffer, layout: tuple):
     return cls(*fields)
 
 
+def stack_batches(batches):
+    """K same-shape batches → one batch whose arrays carry a leading [K]
+    axis — the superbatch wire format for ``StreamingSGDModel.step_many``
+    (one transfer + one dispatch per K micro-batches). All batches must
+    share type, shapes, and dtypes (the padded-bucket contract guarantees
+    this within a stream)."""
+    first = batches[0]
+    for b in batches[1:]:
+        if type(b) is not type(first):
+            raise TypeError("cannot stack mixed batch types")
+    return type(first)(*(np.stack(arrs) for arrs in zip(*batches)))
+
+
 def _bucket(n: int, minimum: int = 8) -> int:
     """Next power-of-two bucket ≥ n (≥ minimum), to bound compile count."""
     b = minimum

@@ -189,13 +189,20 @@ class StreamingContext:
         self._stream = RawStream()
         return self._stream
 
-    def _drain(self) -> list[Status]:
+    def _drain(self, limit: int = 0) -> list[Status]:
+        """Drain queued items; ``limit`` caps the drained ROW count (a
+        ParsedBlock item counts its rows, a Status counts 1 — one block can
+        overshoot the cap, exactly like it overshoots a pinned bucket)."""
         out: list[Status] = []
-        while True:
+        rows = 0
+        while not limit or rows < limit:
             try:
-                out.append(self._queue.get_nowait())
+                item = self._queue.get_nowait()
             except queue.Empty:
-                return out
+                break
+            out.append(item)
+            rows += getattr(item, "rows", 1)
+        return out
 
     def _run_batch(self, statuses: list[Status], batch_time: float) -> None:
         try:
@@ -205,13 +212,29 @@ class StreamingContext:
             log.exception("batch at t=%.3f failed", batch_time)
 
     def _scheduler_loop(self) -> None:
+        # back-to-back mode (--seconds 0) with a pinned row bucket: cap each
+        # batch at the bucket so a fast source yields deterministic
+        # fixed-size batches (the run_to_completion semantic) instead of one
+        # giant drain — bounded memory, one compiled shape, and the unit
+        # --superBatch groups. Wall-clock mode drains the full interval.
+        limit = (
+            getattr(self._stream, "row_bucket", 0)
+            if self.batch_interval == 0
+            else 0
+        )
         next_tick = time.monotonic() + self.batch_interval
         while not self._stop.is_set():
             delay = next_tick - time.monotonic()
             if delay > 0 and self._stop.wait(delay):
                 break
             next_tick += self.batch_interval
-            self._run_batch(self._drain(), time.time())
+            if limit and self._queue.qsize() < limit and not self._source.exhausted:
+                # fill the bucket before processing: batch boundaries stay
+                # deterministic (full buckets + one tail) instead of racing
+                # the producer — the run_to_completion contract
+                self._stop.wait(0.002)
+                continue
+            self._run_batch(self._drain(limit), time.time())
             if self._source.exhausted and self._queue.empty():
                 break
         self._terminated.set()
