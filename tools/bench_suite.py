@@ -27,6 +27,7 @@ one JSON line: {"config", "tweets_per_sec", "seconds", "batches", "final_metric"
 "backend", "skipped"?}. The headline single-number benchmark stays bench.py.
 
 Usage: python tools/bench_suite.py [--tweets N] [--batch B] [--json out.jsonl]
+       [--configs name,name,...]   (default: all)
 """
 
 from __future__ import annotations
@@ -324,6 +325,7 @@ def run_config(name: str, n_tweets: int, batch_size: int) -> dict:
 def main(argv=None) -> None:
     args = list(sys.argv[1:] if argv is None else argv)
     n_tweets, batch_size, out_path, child = 8192, 2048, "", ""
+    selected = list(CONFIGS)
     i = 0
     while i < len(args):
         if args[i] == "--tweets":
@@ -334,6 +336,12 @@ def main(argv=None) -> None:
             out_path = args[i + 1]; i += 2
         elif args[i] == "--config":
             child = args[i + 1]; i += 2
+        elif args[i] == "--configs":
+            selected = [c for c in args[i + 1].split(",") if c]
+            unknown = set(selected) - set(CONFIGS)
+            if unknown:
+                raise SystemExit(f"unknown configs: {sorted(unknown)}")
+            i += 2
         else:
             raise SystemExit(f"unknown flag {args[i]!r}")
 
@@ -341,7 +349,7 @@ def main(argv=None) -> None:
 
     if child:
         real = os.environ.get("TWTML_REAL_DEVICES")
-        if child.startswith("sharded_dp4") and (
+        if child.startswith("sharded_") and (
             force_cpu or (real is not None and int(real) < 4)
         ):
             # parent saw <4 real chips (or CPU was requested): run the mesh
@@ -377,7 +385,7 @@ def main(argv=None) -> None:
     env = dict(os.environ, TWTML_REAL_DEVICES=str(n_real))
 
     lines = []
-    for name in CONFIGS:
+    for name in selected:
         proc = None
         try:
             proc = subprocess.run(

@@ -273,31 +273,30 @@ def attach_super_batcher(conf, stream, model, handle):
             "--tokenBucket so every grouped batch compiles to one program"
         )
 
-    if k <= 1:
-        def per_batch(batch, t):
+    import jax
+
+    def skip_empty(fn):
+        def cb(batch, t):
             if batch.num_valid == 0:
                 log.debug("batch: 0")
                 return
-            import jax
+            fn(batch, t)
 
+        return cb
+
+    if k <= 1:
+        def per_batch(batch, t):
             # ONE host transfer for the whole StepOutput: the handlers read
             # every field, and sequential scalar fetches each pay a full
             # transport round trip (BENCHMARKS.md telemetry regime)
             out = jax.device_get(model.step(batch))
             handle(out, batch, t, at_boundary=True)
 
-        stream.foreach_batch(per_batch)
+        stream.foreach_batch(skip_empty(per_batch))
         return (lambda: None), 1
 
     batcher = SuperBatcher(model, k, handle)
-
-    def grouped(batch, t):
-        if batch.num_valid == 0:
-            log.debug("batch: 0")
-            return
-        batcher.on_batch(batch, t)
-
-    stream.foreach_batch(grouped)
+    stream.foreach_batch(skip_empty(batcher.on_batch))
     return batcher.flush, k
 
 
