@@ -121,6 +121,52 @@ class TestAppResume:
         out = capsys.readouterr().out
         assert "count: 12" in out
 
+    def test_logistic_app_checkpoints_and_resumes(self, tmp_path):
+        """--checkpointDir works on every SGD entry point, not just the
+        flagship (shared AppCheckpoint wiring, apps/common.py)."""
+        from twtml_tpu.apps.logistic_regression import run
+
+        def conf():
+            return ConfArguments().parse([
+                "--source", "replay", "--replayFile", DATA,
+                "--seconds", "1", "--backend", "cpu",
+                "--checkpointDir", str(tmp_path), "--checkpointEvery", "1",
+                "--lightning", "http://127.0.0.1:9",
+                "--twtweb", "http://127.0.0.1:9",
+            ])
+
+        first = run(conf())
+        assert first["count"] == 6
+        weights_after_first, meta = Checkpointer(str(tmp_path)).restore()
+        assert meta["count"] == 6
+        second = run(conf())
+        assert second["count"] == 12
+
+    def test_kmeans_app_checkpoints_and_resumes(self, tmp_path):
+        """Cluster state (centers + decay weights) checkpoints and resumes;
+        a resumed run continues from the saved centers, not fresh randoms."""
+        from twtml_tpu.apps.kmeans import run
+
+        def conf():
+            return ConfArguments().parse([
+                "--source", "replay", "--replayFile", DATA,
+                "--seconds", "1", "--backend", "cpu",
+                "--checkpointDir", str(tmp_path), "--checkpointEvery", "1",
+                "--lightning", "http://127.0.0.1:9",
+                "--twtweb", "http://127.0.0.1:9",
+            ])
+
+        first = run(conf())
+        assert first["count"] > 0
+        state, meta = Checkpointer(str(tmp_path)).restore()
+        assert set(state) == {"centers", "weights"}
+        assert meta["batches"] == first["batches"]
+        second = run(conf())
+        assert second["count"] == 2 * first["count"]
+        state2, _ = Checkpointer(str(tmp_path)).restore()
+        # decay weights kept accumulating across the resume
+        assert np.sum(state2["weights"]) > np.sum(state["weights"])
+
 
 class TestTracer:
     def test_disabled_tracer_is_noop(self):

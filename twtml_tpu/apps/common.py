@@ -162,6 +162,60 @@ def build_model(conf, model_cls=StreamingLinearRegressionWithSGD):
     return model_cls.from_conf(conf), 1
 
 
+class AppCheckpoint:
+    """``--checkpointDir``/``--checkpointEvery`` wiring shared by every entry
+    point (model checkpoint/resume is this framework's upgrade over the
+    reference, SURVEY.md §5.4 — a restarted reference job begins from
+    zeros). Restores state + counters at startup, saves on a cadence-
+    crossing test at weight-current boundaries (so ``--superBatch`` groups
+    snap to the first boundary at/after each cadence point instead of
+    stretching to lcm), and saves final state at shutdown.
+
+    ``get_state()`` returns the checkpointable arrays (flat dict or one
+    array); ``set_state(state)`` restores them into the model."""
+
+    def __init__(self, conf, get_state, set_state, totals: dict):
+        self._ckpt = None
+        self._get_state = get_state
+        self.every = int(getattr(conf, "checkpointEvery", 0) or 0)
+        if not conf.checkpointDir:
+            self._last = 0
+            return
+        from ..checkpoint import Checkpointer
+
+        self._ckpt = Checkpointer(conf.checkpointDir)
+        restored = self._ckpt.restore()
+        if restored is not None:
+            state, meta = restored
+            set_state(state)
+            totals["count"] = int(meta.get("count", 0))
+            totals["batches"] = int(meta.get("batches", 0))
+            log.info(
+                "resumed from checkpoint step %s (count=%s)",
+                meta.get("step"), totals["count"],
+            )
+        self._last = totals["batches"]
+
+    def _save(self, totals: dict) -> None:
+        self._ckpt.save(
+            totals["batches"], self._get_state(),
+            {"count": totals["count"], "batches": totals["batches"]},
+        )
+        self._last = totals["batches"]
+
+    def maybe_save(self, totals: dict, at_boundary: bool = True) -> None:
+        """Cadence save — call per batch from the app's handler."""
+        if self._ckpt is not None and at_boundary and self.every > 0 and (
+            totals["batches"] - self._last >= self.every
+        ):
+            self._save(totals)
+
+    def final_save(self, totals: dict) -> None:
+        """Shutdown save when anything advanced past the last save."""
+        if self._ckpt is not None and totals["batches"] != self._last:
+            self._save(totals)
+
+
 class SuperBatcher:
     """Group K featurized micro-batches into ONE device dispatch
     (``model.step_many``: a lax.scan of the ordinary train step) and re-emit

@@ -33,7 +33,7 @@ from ..streaming.context import StreamingContext
 from ..streaming.sources import Source
 from ..telemetry.lightning import CHART_MAX_POINTS, Lightning
 from ..utils import get_logger
-from .common import build_mesh, build_source, select_backend
+from .common import AppCheckpoint, build_mesh, build_source, select_backend
 
 log = get_logger("apps.kmeans")
 
@@ -116,6 +116,20 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
     ssc = StreamingContext(batch_interval=conf.seconds)
     totals = {"count": 0, "batches": 0}
 
+    # checkpoint/resume of the cluster state — same upgrade as the SGD apps
+    # (SURVEY.md §5.4); state = centers + per-center decay weights
+    ckpt = AppCheckpoint(
+        conf,
+        get_state=lambda: {
+            "centers": model.latest_centers,
+            "weights": np.asarray(model.cluster_weights),
+        },
+        set_state=lambda st: model.set_initial_centers(
+            st["centers"], st["weights"]
+        ),
+        totals=totals,
+    )
+
     def _rows_for(n: int) -> int:
         """The central padding policy (features/batch.py): power-of-two
         bucket, rounded to the mesh's data-axis multiple."""
@@ -168,20 +182,26 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
             chart_q.put_nowait((scaled[:m, 0], scaled[:m, 1], pred[:m]))
         except queue.Full:
             pass
+        ckpt.maybe_save(totals)
         if max_batches and totals["batches"] >= max_batches:
             ssc.request_stop()
 
     ssc.raw_stream(source).foreach_batch(on_batch)
-    if wall_clock:
-        ssc.start()
-        try:
-            ssc.await_termination()
-        except KeyboardInterrupt:
-            pass
-        finally:
-            ssc.stop()
-    else:
-        ssc.run_to_completion()
+    try:
+        if wall_clock:
+            ssc.start()
+            try:
+                ssc.await_termination()
+            except KeyboardInterrupt:
+                pass
+            finally:
+                ssc.stop()
+        else:
+            ssc.run_to_completion()
+    finally:
+        # like the sibling apps: the shutdown save must survive a handler
+        # exception or Ctrl-C (run_to_completion raises on the main thread)
+        ckpt.final_save(totals)
     return totals
 
 

@@ -26,6 +26,7 @@ from ..utils import get_logger, round_half_up
 # shared app runtime (apps/common.py); re-exported here because this is the
 # flagship entry other modules historically import the helpers from
 from .common import (  # noqa: F401
+    AppCheckpoint,
     attach_super_batcher,
     build_model,
     build_source,
@@ -57,26 +58,16 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     totals = {"count": 0, "batches": 0}
 
     # checkpoint/resume (upgrade over the reference, SURVEY.md §5.4)
-    ckpt = None
-    if conf.checkpointDir:
-        from ..checkpoint import Checkpointer
-
-        ckpt = Checkpointer(conf.checkpointDir)
-        restored = ckpt.restore()
-        if restored is not None:
-            weights, meta = restored
-            model.set_initial_weights(weights)
-            totals["count"] = int(meta.get("count", 0))
-            totals["batches"] = int(meta.get("batches", 0))
-            log.info(
-                "resumed from checkpoint step %s (count=%s)",
-                meta.get("step"), totals["count"],
-            )
+    ckpt = AppCheckpoint(
+        conf,
+        get_state=lambda: model.latest_weights,
+        set_state=model.set_initial_weights,
+        totals=totals,
+    )
 
     from ..utils.tracing import Tracer
 
     tracer = Tracer(conf.profileDir)
-    last_saved = {"step": totals["batches"]}
 
     def handle(out, batch, _batch_time, at_boundary=True) -> None:
         b = int(out.count)
@@ -97,18 +88,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         session.update(
             totals["count"], b, mse, real_stdev, pred_stdev, real, pred
         )
-        # at_boundary: under --superBatch the weights are only current on
-        # group boundaries — a save lands on the FIRST boundary at/after
-        # each cadence point (crossing test, not modulo: a modulo test
-        # would silently stretch the cadence to lcm(K, checkpointEvery))
-        if ckpt is not None and at_boundary and conf.checkpointEvery > 0 and (
-            totals["batches"] - last_saved["step"] >= conf.checkpointEvery
-        ):
-            ckpt.save(
-                totals["batches"], model.latest_weights,
-                {"count": totals["count"], "batches": totals["batches"]},
-            )
-            last_saved["step"] = totals["batches"]
+        ckpt.maybe_save(totals, at_boundary)
         if max_batches and totals["batches"] >= max_batches:
             ssc.request_stop()
 
@@ -127,11 +107,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         ssc.stop()
         flush_group()  # drain a partial superbatch group before final state
         tracer.stop()
-        if ckpt is not None and totals["batches"] != last_saved["step"]:
-            ckpt.save(
-                totals["batches"], model.latest_weights,
-                {"count": totals["count"], "batches": totals["batches"]},
-            )
+        ckpt.final_save(totals)
     return totals
 
 

@@ -29,6 +29,7 @@ from ..streaming.context import StreamingContext
 from ..telemetry.session_stats import SessionStats
 from ..utils import get_logger, round_half_up
 from .common import (
+    AppCheckpoint,
     attach_super_batcher,
     build_model,
     build_source,
@@ -60,6 +61,14 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     )
     totals = {"count": 0, "batches": 0}
 
+    # checkpoint/resume — same upgrade as the flagship app (SURVEY.md §5.4)
+    ckpt = AppCheckpoint(
+        conf,
+        get_state=lambda: model.latest_weights,
+        set_state=model.set_initial_weights,
+        totals=totals,
+    )
+
     def handle(out, batch, _batch_time, at_boundary=True) -> None:
         b = int(out.count)
         totals["count"] += b
@@ -80,6 +89,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
             round_half_up(float(out.pred_stdev) * 100),
             real, pred,
         )
+        ckpt.maybe_save(totals, at_boundary)
         if max_batches and totals["batches"] >= max_batches:
             ssc.request_stop()
 
@@ -93,6 +103,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     finally:
         ssc.stop()
         flush_group()  # drain a partial superbatch group
+        ckpt.final_save(totals)
     return totals
 
 
