@@ -76,6 +76,33 @@ def test_logistic_superbatch_matches_sequential():
     )
 
 
+def test_mesh_step_many_matches_sequential():
+    """ParallelSGDModel.step_many (scan inside shard_map) equals K
+    sequential sharded steps on BOTH mesh layouts — so --superBatch works
+    under --master local[N] too."""
+    import jax
+
+    from twtml_tpu.parallel import ParallelSGDModel, make_mesh
+    from twtml_tpu.parallel.sharding import shard_batch
+
+    batches = featurized_batches(n=4, rows=32)
+    for mesh_kw in (dict(num_data=4), dict(num_data=2, num_model=2)):
+        mesh = make_mesh(devices=jax.devices()[:4], **mesh_kw)
+        seq = ParallelSGDModel(mesh, num_iterations=5, step_size=0.05)
+        outs = [seq.step(shard_batch(b, mesh)) for b in batches]
+        sup = ParallelSGDModel(mesh, num_iterations=5, step_size=0.05)
+        stacked = shard_batch(stack_batches(batches), mesh)
+        many = sup.step_many(stacked)
+        np.testing.assert_allclose(
+            sup.latest_weights, seq.latest_weights, rtol=1e-6, atol=1e-7
+        )
+        for k, out in enumerate(outs):
+            assert float(many.mse[k]) == float(out.mse)
+            np.testing.assert_array_equal(
+                np.asarray(many.predictions[k]), np.asarray(out.predictions)
+            )
+
+
 def test_linear_app_superbatch_identical_stats(tmp_path, capsys):
     """The flagship app with --superBatch 3 prints the IDENTICAL per-batch
     stats lines (same batch boundaries, same mse/stdev sequence) and ends

@@ -315,12 +315,6 @@ def attach_super_batcher(conf, stream, model, handle):
             "would delay live stats by %d intervals", k, conf.seconds, k,
         )
         k = 1
-    if k > 1 and not hasattr(model, "step_many"):
-        log.warning(
-            "--superBatch %d ignored: %s has no scanned step (mesh-sharded "
-            "learners run per-batch)", k, type(model).__name__,
-        )
-        k = 1
     if k > 1 and (stream.row_bucket <= 0 or stream.token_bucket <= 0):
         raise ValueError(
             "--superBatch needs pinned shapes: set --batchBucket and "
@@ -383,7 +377,7 @@ def warmup_compile(stream, model, super_batch: int = 1) -> None:
         variants.append(empty._replace(units=empty.units.astype(np.uint16)))
     for v in variants:
         model.step(v)
-    if super_batch > 1 and hasattr(model, "step_many"):
+    if super_batch > 1:
         # --superBatch dispatches a scanned program too: warm it for the
         # same shapes/dtypes so the first full group doesn't stall
         from ..features.batch import stack_batches

@@ -77,10 +77,24 @@ def _pspecs_for(batch_cls, data_axis: str):
     )
 
 
+def _stacked(spec_tree):
+    """Prepend an unsharded leading axis to every PartitionSpec — the specs
+    for a superbatch ([K, ...] leaves, K scanned on-device)."""
+    return jax.tree_util.tree_map(
+        lambda s: P(*((None,) + tuple(s))),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
 def shard_batch(batch: FeatureBatch | UnitBatch, mesh):
     """Place a host batch onto the mesh with row sharding (explicit
-    device_put so repeated steps don't re-infer layouts)."""
+    device_put so repeated steps don't re-infer layouts). Stacked
+    superbatches ([K, ...] leaves — detected by the mask rank) shard their
+    row axis the same way with K unsharded."""
     specs = _pspecs_for(type(batch), mesh.axis_names[0])
+    if batch.mask.ndim == 2:  # stacked: [K, B] mask
+        specs = _stacked(specs)
     return type(batch)(*(
         jax.device_put(arr, NamedSharding(mesh, spec))
         for arr, spec in zip(batch, specs)
@@ -353,6 +367,32 @@ class ParallelSGDModel:
             self._sharded[batch_cls] = fn
         return fn
 
+    def _scan_for(self, batch_cls) -> Callable:
+        """The superbatch program: lax.scan of the per-shard step body over a
+        stacked batch ([K, ...] leaves; K unsharded, rows sharded as usual).
+        Same math as K sequential steps — the scan carries the weights
+        through the identical body (mirrors StreamingSGDModel.step_many)."""
+        key = (batch_cls, "scan")
+        fn = self._sharded.get(key)
+        if fn is None:
+            body = self._step_body
+
+            def scanned(weights, stacked_batch):
+                return lax.scan(body, weights, stacked_batch)
+
+            sharded = jax.shard_map(
+                scanned,
+                mesh=self.mesh,
+                in_specs=(
+                    self._w_spec,
+                    _stacked(_pspecs_for(batch_cls, self.data_axis)),
+                ),
+                out_specs=(self._out_specs[0], _stacked(self._out_specs[1])),
+            )
+            fn = jax.jit(sharded, donate_argnums=0)
+            self._sharded[key] = fn
+        return fn
+
     @classmethod
     def from_conf(cls, conf, mesh, **overrides):
         kwargs = dict(
@@ -412,15 +452,28 @@ class ParallelSGDModel:
             self._weights = jnp.asarray(weights)
         return self
 
-    def step(self, batch: FeatureBatch | UnitBatch) -> StepOutput:
-        b = batch.mask.shape[0]
-        if b % self.num_data:
+    def _check_rows(self, rows: int) -> None:
+        if rows % self.num_data:
             raise ValueError(
-                f"batch rows {b} not divisible by data shards {self.num_data}; "
-                f"set --batchBucket to a multiple of the mesh's data axis"
+                f"batch rows {rows} not divisible by data shards "
+                f"{self.num_data}; set --batchBucket to a multiple of the "
+                f"mesh's data axis"
             )
+
+    def step(self, batch: FeatureBatch | UnitBatch) -> StepOutput:
+        self._check_rows(batch.mask.shape[0])
         self._weights, out = self._step_for(type(batch))(self._weights, batch)
         return out
+
+    def step_many(self, stacked: FeatureBatch | UnitBatch) -> StepOutput:
+        """K micro-batch steps as one dispatch over the mesh (superbatch:
+        ``features.batch.stack_batches``); per-batch stats return along
+        axis 0. See ``_scan_for``."""
+        self._check_rows(stacked.mask.shape[1])
+        self._weights, outs = self._scan_for(type(stacked))(
+            self._weights, stacked
+        )
+        return outs
 
     def train_on(self, stream) -> None:
         stream.foreach_batch(lambda batch, _time: self.step(batch))
