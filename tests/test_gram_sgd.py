@@ -351,6 +351,36 @@ def test_full_scale_2e18_gram_matches_scatter():
     np.testing.assert_allclose(np.asarray(w_g), np.asarray(w_s), rtol=1e-4, atol=1e-7)
 
 
+def test_randomized_config_sweep_matches_scatter():
+    """Property-style sweep: random knob combinations (step size, L2,
+    sampling fraction, convergence tol, iterations, batch/token shapes,
+    value ranges) — every one must keep the two formulations together.
+    Seeded, so a failure names its config and reproduces exactly."""
+    rng = np.random.default_rng(2026)
+    for trial in range(6):
+        knobs = dict(
+            num_iterations=int(rng.integers(4, 30)),
+            step_size=float(rng.choice([0.005, 0.05, 0.2])),
+            l2_reg=float(rng.choice([0.0, 0.01, 0.1])),
+            mini_batch_fraction=float(rng.choice([1.0, 0.7, 0.4])),
+            convergence_tol=float(rng.choice([0.0, 0.001, 0.05])),
+        )
+        b = int(rng.integers(8, 40))
+        l = int(rng.integers(4, 20))
+        batches = [
+            random_batch(rng, b=b, l=l, label_scale=float(rng.choice([5.0, 500.0])))
+            for _ in range(2)
+        ]
+        w0 = (rng.normal(size=(F_TEXT + NUM_NUMBER_FEATURES,)) * 0.1).astype(
+            np.float32
+        )
+        try:
+            res = both_paths(batches, w0, **knobs)
+            assert_trajectories_match(*res)
+        except AssertionError as exc:  # name the failing config
+            raise AssertionError(f"trial {trial} knobs={knobs} b={b} l={l}: {exc}")
+
+
 def test_auto_gate_picks_gram_only_when_it_fits():
     assert fits_gram(2048, 2**18, 50)
     assert not fits_gram(2048, 2**18, 2)  # too few iterations to amortize

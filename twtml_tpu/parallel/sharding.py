@@ -352,7 +352,9 @@ class ParallelSGDModel:
                 pred_stdev=scalar,
             ),
         )
-        self._sharded: dict[type, Callable] = {}
+        # compiled programs: keyed by batch class, plus (cls, 'scan')
+        # for the superbatch variants
+        self._sharded: dict[object, Callable] = {}
 
     def _step_for(self, batch_cls) -> Callable:
         fn = self._sharded.get(batch_cls)
