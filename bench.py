@@ -33,13 +33,19 @@ sys.path.insert(0, REPO)
 N_TWEETS = 65536
 BATCH = 2048
 WARMUP_BATCHES = 2
-# best-of with a time budget: passes are ~0.06 s, but transport stalls come
-# in bursts up to minutes long — keep sampling until the best has settled
-# (8 consecutive non-improving passes) or the budget runs out, so a stall
-# window at the wrong moment can't masquerade as the sustained rate
+# best-of over a FIXED time budget, no early settle: the tunnel's health
+# swings the rate 2-3× on ~10-minute phases (measured r2), and a settle
+# check "converges" on whatever phase it lands in — during a degraded
+# phase every pass is uniformly slow, so early-stopping just records the
+# degraded rate. The headline runs once per round; a budget on the order
+# of a phase length maximizes the chance that some passes land in a
+# healthy window (no guarantee — a run that starts a fresh degraded
+# phase can still spend its whole budget inside it), and the median in
+# the output exposes when that happened. Watchdog margin: 600 s + compile
+# stays well under the 1200 s per-child TWTML_BENCH_TIMEOUT.
 REPEATS = 6
-TIME_BUDGET_S = 150.0
-SETTLED_AFTER = 8
+TIME_BUDGET_S = 600.0
+SETTLED_AFTER = 0
 
 
 def measure(
@@ -117,7 +123,7 @@ def main() -> None:
     # device measurement with a watchdog (TWTML_BENCH_TIMEOUT seconds):
     # a dead TPU tunnel yields a CPU-fallback record instead of a hang and
     # no record at all. Healthy run ≈ compile (20-40 s) + a pass loop that may
-    # legitimately spend up to TIME_BUDGET_S (150 s) riding out transport
+    # legitimately spend up to TIME_BUDGET_S (600 s) riding out transport
     # stalls; the margin above that covers a degraded-but-alive tunnel.
     timeout = float(os.environ.get("TWTML_BENCH_TIMEOUT", "1200"))
     device_result, device_err = _run_child("device", timeout)
