@@ -179,3 +179,63 @@ def test_hash_on_flag_and_validation(isolated_env, tmp_path, monkeypatch):
 def test_token_bucket_flag(isolated_env):
     assert ConfArguments().tokenBucket == 0
     assert ConfArguments().parse(["--tokenBucket", "128"]).tokenBucket == 128
+
+
+def test_multihost_flags_and_twtml_master(isolated_env):
+    conf = ConfArguments().parse([
+        "--coordinator", "10.0.0.1:1234",
+        "--numProcesses", "4", "--processId", "2",
+    ])
+    conf.validate_master()
+    assert conf.multihost() == ("10.0.0.1:1234", 4, 2)
+
+    # twtml:// master URL is the one-flag cluster form: fills --coordinator
+    conf = ConfArguments().parse([
+        "--master", "twtml://10.0.0.9:7077",
+        "--numProcesses", "2", "--processId", "0",
+    ])
+    conf.validate_master()
+    assert conf.coordinator == "10.0.0.9:7077"
+    assert conf.multihost() == ("10.0.0.9:7077", 2, 0)
+
+    # single-host stays single-host
+    conf = ConfArguments()
+    conf.validate_master()
+    assert conf.multihost() is None
+
+
+def test_unsupported_master_scheme_rejected(isolated_env):
+    # the reference accepts spark://host:port (ConfArguments.scala:95-98);
+    # this runtime can't honor it, and silently running single-host would
+    # be worse than rejecting (VERDICT r2) — so it rejects, loudly
+    conf = ConfArguments().parse(["--master", "spark://h:7077"])
+    with pytest.raises(SystemExit):
+        conf.validate_master()
+    conf = ConfArguments().parse(["--master", "twtml://"])
+    with pytest.raises(SystemExit):
+        conf.validate_master()
+    # conflicting coordinator vs master URL
+    conf = ConfArguments().parse([
+        "--master", "twtml://a:1", "--coordinator", "b:2",
+    ])
+    with pytest.raises(SystemExit):
+        conf.validate_master()
+
+
+def test_multihost_coordinate_validation(isolated_env):
+    conf = ConfArguments().parse(["--coordinator", "h:1"])
+    with pytest.raises(SystemExit):
+        conf.multihost()  # missing --numProcesses/--processId
+    conf = ConfArguments().parse([
+        "--coordinator", "h:1", "--numProcesses", "2", "--processId", "5",
+    ])
+    with pytest.raises(SystemExit):
+        conf.multihost()  # rank out of range
+
+
+def test_half_specified_cluster_coordinates_rejected(isolated_env):
+    # --numProcesses without --coordinator must not silently run single-host
+    # (it would double-train the stream and race checkpoint writers)
+    conf = ConfArguments().parse(["--numProcesses", "2", "--processId", "0"])
+    with pytest.raises(SystemExit):
+        conf.multihost()

@@ -1,0 +1,91 @@
+"""One-batch-lag telemetry fetch (apps/common.LagPipeline): back-to-back
+apps emit batch k−1's stats just before dispatching batch k, so the stats
+round trip overlaps the next batch's work. The pipeline must preserve the
+synchronous path's semantics exactly: every batch handled once, in order,
+weights current at handle time (at_boundary=True), max-batches stops
+vetoing further dispatches, and the final batch drained by flush()."""
+
+import json
+import os
+
+import numpy as np
+
+from twtml_tpu.apps.common import LagPipeline
+from twtml_tpu.config import ConfArguments
+from twtml_tpu.streaming.sources import SyntheticSource
+
+DATA = os.path.join(os.path.dirname(__file__), "data", "tweets.jsonl")
+
+
+class FakeModel:
+    def __init__(self):
+        self.dispatched = []
+
+    def step(self, batch):
+        self.dispatched.append(batch)
+        return {"i": np.asarray(batch)}
+
+
+def test_emits_in_order_with_one_batch_lag_and_flush():
+    model, events = FakeModel(), []
+    pipe = LagPipeline(
+        model, lambda out, b, t, at_boundary: events.append((int(out["i"]), at_boundary))
+    )
+    for i in range(4):
+        pipe.on_batch(i, 0.0)
+        # batch i dispatched, batch i-1 handled: exactly one batch of lag
+        assert model.dispatched == list(range(i + 1))
+        assert events == [(j, True) for j in range(i)]
+    pipe.flush()
+    assert events == [(j, True) for j in range(4)]
+    pipe.flush()  # idempotent
+    assert len(events) == 4
+
+
+def test_stop_requested_vetoes_the_next_dispatch():
+    model, events = FakeModel(), []
+    stop = {"flag": False}
+
+    def handle(out, b, t, at_boundary):
+        events.append(int(out["i"]))
+        if out["i"] >= 1:
+            stop["flag"] = True  # cap reached at batch 1
+
+    pipe = LagPipeline(model, handle, stop_requested=lambda: stop["flag"])
+    for i in range(5):
+        pipe.on_batch(i, 0.0)
+    pipe.flush()
+    # batch 2 arrived after handle(1) set the stop: it must not dispatch,
+    # and later batches must not either
+    assert model.dispatched == [0, 1]
+    assert events == [0, 1]
+
+
+def test_linear_app_max_batches_exact_under_lag(tmp_path):
+    """The flagship app in back-to-back mode (--seconds 0, where the lag
+    pipeline engages) trains EXACTLY max_batches batches, as the inline
+    fetch did."""
+    import jax
+
+    from tools.bench_suite import _status_json
+    from twtml_tpu.apps import linear_regression as app
+
+    jax.devices()  # lock the conftest's 8-device backend before local[1]
+
+    path = tmp_path / "tweets.jsonl"
+    statuses = list(
+        SyntheticSource(total=8 * 16, seed=11, base_ms=1785320000000).produce()
+    )
+    with open(path, "w") as fh:
+        for s in statuses:
+            fh.write(json.dumps(_status_json(s)) + "\n")
+
+    conf = ConfArguments().parse([
+        "--source", "replay", "--replayFile", str(path),
+        "--seconds", "0", "--backend", "cpu",
+        "--batchBucket", "16", "--tokenBucket", "64",
+        "--master", "local[1]",
+    ])
+    totals = app.run(conf, max_batches=3)
+    assert totals["batches"] == 3
+    assert totals["count"] == 3 * 16

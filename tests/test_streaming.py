@@ -205,6 +205,58 @@ def test_bucket_overflow_warns_once(caplog):
     assert len(warnings) == 1
 
 
+def test_block_fill_gate_counts_rows_not_items():
+    """Regression (ADVICE r2): the --seconds 0 fill gate must compare queued
+    ROWS to the row bucket. Each block item is many rows; an item-count gate
+    never fills, so the scheduler buffers the ENTIRE stream before the first
+    batch — this source deadlocks (then times out) unless a batch runs while
+    it is still producing."""
+    from twtml_tpu.features.blocks import ParsedBlock
+
+    def block(rows):
+        units = np.tile(
+            np.frombuffer(b"ab", np.uint8).astype(np.uint16), rows
+        )
+        numeric = np.zeros((rows, 5), np.int64)
+        numeric[:, 0] = 500  # label within the default retweet interval
+        return ParsedBlock(
+            numeric,
+            units,
+            np.arange(rows + 1, dtype=np.int64) * 2,
+            np.ones((rows,), np.uint8),
+        )
+
+    batch_done = threading.Event()
+
+    class GatedBlocks(Source):
+        name = "gated-blocks"
+
+        def produce(self):
+            yield block(64)
+            yield block(64)
+            # 128 rows (= the bucket) are queued as TWO items: the scheduler
+            # must batch them while this source is still alive
+            assert batch_done.wait(5.0), "no batch while source alive"
+            yield block(64)
+
+    ssc = StreamingContext(batch_interval=0)
+    stream = ssc.source_stream(
+        GatedBlocks(max_restarts=0), Featurizer(now_ms=0),
+        row_bucket=128, token_bucket=16,
+    )
+    seen = []
+
+    def on_batch(batch, t):
+        seen.append(int(batch.mask.sum()))
+        batch_done.set()
+
+    stream.foreach_batch(on_batch)
+    ssc.start()
+    assert ssc.await_termination(timeout=15)
+    ssc.stop()
+    assert seen[0] == 128 and sum(seen) == 192
+
+
 def test_steady_state_stream_compiles_exactly_once():
     """Shape discipline guard: with pinned buckets, N same-shaped batches
     must reuse ONE compiled train-step program — recompile churn is this

@@ -19,6 +19,30 @@ from ..utils import get_logger
 log = get_logger("apps.common")
 
 
+def init_distributed(conf) -> bool:
+    """The cluster face of every entry point (the reference's one-flag story:
+    ``--master spark://host:port`` runs the same main on a cluster,
+    ConfArguments.scala:95-98, README.md:44-55). Validates --master (bad
+    schemes are rejected, not ignored), and when ``--coordinator``/
+    ``twtml://`` asks for a multi-host group, joins it via
+    ``parallel.distributed.initialize`` — which MUST happen before anything
+    initializes the XLA backend, so apps call this first.
+
+    Returns True when this process should own telemetry/prints (the lead —
+    process 0, or any single-host run)."""
+    conf.validate_master()
+    mh = conf.multihost()
+    if mh is None:
+        return True
+    from ..parallel.distributed import initialize
+
+    coordinator, num_processes, process_id = mh
+    initialize(coordinator, num_processes, process_id)
+    import jax
+
+    return jax.process_index() == 0
+
+
 def select_backend(conf) -> None:
     """--backend {auto,tpu,cpu}: auto keeps jax's platform choice (TPU when
     attached); cpu forces the host backend (the reference's local[*] analog,
@@ -58,6 +82,24 @@ def build_source(
     ParsedBlocks (linear: default labels; logistic: unit_label_fn; k-means:
     numeric columns, which passes ``block_interval`` to override the
     parser's retweet-count filter — it keeps ALL retweets)."""
+    import jax
+
+    multihost = jax.process_count() > 1
+    if multihost:
+        # per-host intake sharding (SURVEY.md §7 stage 5): each process
+        # keeps rows i-of-N of the deterministic stream, so the union of
+        # every host's shard is exactly the single-host stream
+        if conf.source == "twitter":
+            raise SystemExit(
+                "multi-host live Twitter intake is not wired: every host "
+                "opening the same sample stream would duplicate tweets, "
+                "not shard them; use --source replay or synthetic"
+            )
+        if conf.ingest == "block":
+            raise SystemExit(
+                "--ingest block is not wired for multi-host runs; "
+                "use --ingest object"
+            )
     if conf.ingest == "block" and not allow_block:
         raise SystemExit(
             "--ingest block is not wired for this entry point; "
@@ -99,6 +141,12 @@ def build_source(
         source = TwitterSource.from_properties()
     else:
         raise SystemExit(f"unknown --source {conf.source!r}")
+    if multihost:
+        from ..streaming.sources import ShardedSource
+
+        source = ShardedSource(
+            source, jax.process_index(), jax.process_count()
+        )
     return _wrap_faults(source, conf)
 
 
@@ -130,11 +178,27 @@ def build_mesh(conf, what: str = "training"):
     """The one-flag cluster story: the ('data',) mesh the conf calls for, or
     None when a single device (or local[1]) keeps execution unsharded. Every
     entry point routes through here so device selection / local[N] capping
-    can never diverge between apps."""
+    can never diverge between apps.
+
+    Multi-host runs span the WHOLE process group's devices; jax.devices()
+    is process-major, so the 1D data axis is automatically process-aligned
+    (the topology per-host intake sharding requires,
+    parallel/distributed.py)."""
+    import jax
+
+    if jax.process_count() > 1:
+        from ..parallel import make_mesh
+
+        if conf.local_shards():
+            log.warning("--master local[N] hint ignored in a multi-host run")
+        log.info(
+            "multi-host %s: %d processes, %d global devices",
+            what, jax.process_count(), jax.device_count(),
+        )
+        return make_mesh(num_data=jax.device_count(), devices=jax.devices())
     n_data = mesh_shape(conf)
     if n_data <= 1:
         return None
-    import jax
 
     from ..parallel import make_mesh
 
@@ -158,6 +222,17 @@ def build_model(conf, model_cls=StreamingLinearRegressionWithSGD):
             prediction_fn=model_cls.prediction_fn,
             round_predictions=model_cls.round_predictions,
         )
+        import jax
+
+        if jax.process_count() > 1:
+            from ..parallel.distributed import MultiHostSGDModel
+
+            # the app featurizes only THIS host's rows: its local batch
+            # must divide this host's share of the data axis
+            return (
+                MultiHostSGDModel(model, mesh),
+                max(1, model.num_data // jax.process_count()),
+            )
         return model, model.num_data
     return model_cls.from_conf(conf), 1
 
@@ -172,11 +247,20 @@ class AppCheckpoint:
     stretching to lcm), and saves final state at shutdown.
 
     ``get_state()`` returns the checkpointable arrays (flat dict or one
-    array); ``set_state(state)`` restores them into the model."""
+    array); ``set_state(state)`` restores them into the model.
 
-    def __init__(self, conf, get_state, set_state, totals: dict):
+    Multi-host: only the lead (``lead=True``) WRITES (concurrent writers
+    against one directory would race), and restore is LEAD-AUTHORITATIVE —
+    after the local restore attempt, the lead's state/counters are
+    broadcast to every process, so a follower without the lead's filesystem
+    (no shared storage) still resumes consistently instead of silently
+    training from zeros against resumed peers."""
+
+    def __init__(self, conf, get_state, set_state, totals: dict,
+                 lead: bool = True):
         self._ckpt = None
         self._get_state = get_state
+        self._lead = lead
         self.every = int(getattr(conf, "checkpointEvery", 0) or 0)
         if not conf.checkpointDir:
             self._last = 0
@@ -194,9 +278,38 @@ class AppCheckpoint:
                 "resumed from checkpoint step %s (count=%s)",
                 meta.get("step"), totals["count"],
             )
+        import jax
+
+        if jax.process_count() > 1:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            # every process contributes its own (structurally identical)
+            # state; all receive the lead's — process 0 is the writer, so
+            # its view of the checkpoint is the truth
+            meta_arr, state = multihost_utils.broadcast_one_to_all((
+                np.array(
+                    [int(restored is not None),
+                     totals["count"], totals["batches"]], np.int64,
+                ),
+                get_state(),
+            ))
+            # unconditional: a follower restoring a STALE local checkpoint
+            # while the lead starts fresh must also converge on the lead
+            set_state(jax.tree_util.tree_map(np.asarray, state))
+            totals["count"] = int(meta_arr[1])
+            totals["batches"] = int(meta_arr[2])
+            if int(meta_arr[0]) and restored is None:
+                log.info(
+                    "resumed from the lead's broadcast checkpoint "
+                    "(count=%s)", totals["count"],
+                )
         self._last = totals["batches"]
 
     def _save(self, totals: dict) -> None:
+        if not self._lead:
+            self._last = totals["batches"]  # keep cadence bookkeeping aligned
+            return
         self._ckpt.save(
             totals["batches"], self._get_state(),
             {"count": totals["count"], "batches": totals["batches"]},
@@ -286,7 +399,63 @@ class SuperBatcher:
             )
 
 
-def attach_super_batcher(conf, stream, model, handle):
+class LagPipeline:
+    """One-batch-lag telemetry fetch for back-to-back regimes: handle batch
+    k−1's StepOutput (already fetched or in flight, ``copy_to_host_async``
+    at dispatch time) just before dispatching batch k.
+
+    Why: the per-batch stats fetch through this build's TPU tunnel is a
+    ~70–100 ms round trip (BENCHMARKS.md telemetry regime). A synchronous
+    ``device_get`` right after its own dispatch pays the full trip idle;
+    lagging the fetch one batch starts the device→host copy at dispatch
+    time, so the trip overlaps the next batch's featurize + upload and the
+    blocked portion shrinks to what the pipeline couldn't hide.
+
+    Semantics are EXACTLY the synchronous path's: same step, same
+    ``device_get``, per-batch stats; at emit time the lagged batch's step is
+    the newest dispatch, so ``model.latest_weights`` are current as of that
+    batch (``at_boundary=True`` — checkpoints stay correct), and a stop
+    requested by the handler (max-batches caps) vetoes the NEXT dispatch, so
+    exactly as many batches train as with inline fetches. ``flush()`` after
+    stream termination drains the final pending batch."""
+
+    def __init__(self, model, handle, stop_requested=None):
+        self.model = model
+        self.handle = handle
+        self._stop_requested = stop_requested
+        self._pending = None
+
+    def _emit(self) -> None:
+        import jax
+
+        out, batch, t = self._pending
+        self._pending = None
+        self.handle(jax.device_get(out), batch, t, at_boundary=True)
+
+    def on_batch(self, batch, t) -> None:
+        import jax
+
+        stop = self._stop_requested
+        if stop is not None and stop():
+            return  # stop already requested: nothing more may train
+        if self._pending is not None:
+            self._emit()
+            if stop is not None and stop():
+                # the cap landed on the lagged batch: dispatching this one
+                # would train past it — drop it, as the inline path does
+                return
+        out = self.model.step(batch)
+        for leaf in jax.tree_util.tree_leaves(out):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        self._pending = (out, batch, t)
+
+    def flush(self) -> None:
+        if self._pending is not None:
+            self._emit()
+
+
+def attach_super_batcher(conf, stream, model, handle, stop_requested=None):
     """Wire the app's per-batch ``handle(out, batch, t, at_boundary)`` to the
     stream: plain step-then-handle by default, grouped through a
     SuperBatcher when ``--superBatch K`` applies. Returns
@@ -298,6 +467,10 @@ def attach_super_batcher(conf, stream, model, handle):
     this batch (always, except mid-group under a superbatch) — the guard for
     side effects that read ``model.latest_weights``, e.g. checkpoints.
 
+    ``stop_requested``: optional predicate (the app's
+    ``ssc.stop_requested``) that lets the lagged-fetch pipeline honor a
+    max-batches stop exactly (see LagPipeline).
+
     Group-granular caps: a whole group dispatches as one program, so a
     ``max_batches``-style stop lands on the first group boundary at/after
     the cap (up to K−1 extra batches, deterministic — the documented
@@ -308,6 +481,9 @@ def attach_super_batcher(conf, stream, model, handle):
     with a warning. Grouped batches must share one XLA shape, which pinned
     buckets guarantee — unpinned buckets are an error, matching the
     pre-compile contract (``warmup_compile``)."""
+    import jax
+
+    multihost = jax.process_count() > 1
     k = int(getattr(conf, "superBatch", 1) or 1)
     if k > 1 and conf.seconds > 0:
         log.warning(
@@ -315,15 +491,30 @@ def attach_super_batcher(conf, stream, model, handle):
             "would delay live stats by %d intervals", k, conf.seconds, k,
         )
         k = 1
+    if k > 1 and multihost:
+        log.warning(
+            "--superBatch %d ignored: not wired for multi-host runs", k
+        )
+        k = 1
     if k > 1 and (stream.row_bucket <= 0 or stream.token_bucket <= 0):
         raise ValueError(
             "--superBatch needs pinned shapes: set --batchBucket and "
             "--tokenBucket so every grouped batch compiles to one program"
         )
-
-    import jax
+    if multihost and (stream.row_bucket <= 0 or stream.token_bucket <= 0):
+        raise SystemExit(
+            "multi-host runs need pinned shapes: set --batchBucket and "
+            "--tokenBucket (every host must dispatch the same collective "
+            "program every tick, including all-padding batches)"
+        )
 
     def skip_empty(fn):
+        if multihost:
+            # a host whose interval/shard came up empty must STILL dispatch
+            # its all-padding batch — the other hosts' collectives wait on
+            # its program (streaming/context._lockstep_loop)
+            return fn
+
         def cb(batch, t):
             if batch.num_valid == 0:
                 log.debug("batch: 0")
@@ -332,11 +523,33 @@ def attach_super_batcher(conf, stream, model, handle):
 
         return cb
 
+    if multihost:
+        # the LOCAL batch can't gate the step (collectives above), but a
+        # GLOBALLY empty batch (every row filtered out on every host) must
+        # not surface to the app — single-host runs skip those pre-step
+        inner_handle = handle
+
+        def handle(out, batch, t, at_boundary=True):  # noqa: F811
+            if int(out.count) == 0:
+                log.debug("batch: 0 (global)")
+                return
+            inner_handle(out, batch, t, at_boundary=at_boundary)
+
     if k <= 1:
+        if conf.seconds <= 0:
+            # back-to-back: lag the stats fetch one batch so the transport
+            # round trip overlaps the next batch's work (exact per-batch
+            # semantics — see LagPipeline)
+            pipe = LagPipeline(model, handle, stop_requested)
+            stream.foreach_batch(skip_empty(pipe.on_batch))
+            return pipe.flush, 1
+
         def per_batch(batch, t):
-            # ONE host transfer for the whole StepOutput: the handlers read
-            # every field, and sequential scalar fetches each pay a full
-            # transport round trip (BENCHMARKS.md telemetry regime)
+            # wall-clock streaming: ONE synchronous host transfer for the
+            # whole StepOutput (sequential scalar fetches each pay a full
+            # round trip). The fetch is ~2% of a 5 s interval; a lagged
+            # fetch here would delay live dashboard stats a full interval
+            # for nothing.
             out = jax.device_get(model.step(batch))
             handle(out, batch, t, at_boundary=True)
 

@@ -33,7 +33,13 @@ from ..streaming.context import StreamingContext
 from ..streaming.sources import Source
 from ..telemetry.lightning import CHART_MAX_POINTS, Lightning
 from ..utils import get_logger
-from .common import AppCheckpoint, build_mesh, build_source, select_backend
+from .common import (
+    AppCheckpoint,
+    build_mesh,
+    build_source,
+    init_distributed,
+    select_backend,
+)
 
 log = get_logger("apps.kmeans")
 
@@ -87,7 +93,14 @@ def featurize(status: Status) -> np.ndarray:
 
 
 def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> dict:
+    init_distributed(conf)  # every entry point forms the group (apps/common)
     select_backend(conf)
+    if jax.process_count() > 1:
+        raise SystemExit(
+            "multi-host k-means intake is not wired (its raw dense pipeline "
+            "pads rows per batch, which multi-host lockstep can't shape-pin "
+            "yet); --coordinator supports the linear/logistic entry points"
+        )
     # k-means keeps ALL retweets (isRetweet only, NO retweet-count interval —
     # KMeans.scala:77-80): block ingest overrides the parser's interval
     # filter; isRetweet filtering is inherent (rows without a

@@ -30,6 +30,7 @@ from .common import (  # noqa: F401
     attach_super_batcher,
     build_model,
     build_source,
+    init_distributed,
     select_backend,
     warmup_compile,
 )
@@ -38,13 +39,21 @@ log = get_logger("apps.linear")
 
 
 def run(conf: ConfArguments, max_batches: int = 0) -> dict:
+    # multi-host group formation MUST precede any backend use (apps/common)
+    lead = init_distributed(conf)
+
     log.info("Initializing session stats...")
-    session = SessionStats(conf).open()
+    # one telemetry session per RUN, not per host: the lead publishes the
+    # global stats (they are psum-identical on every host); followers train
+    session = SessionStats(conf).open() if lead else None
 
     log.info("Initializing TPU-native streaming model...")
     select_backend(conf)
     featurizer = Featurizer.from_conf(conf)
     model, row_multiple = build_model(conf)
+    import jax
+
+    lockstep = jax.process_count() > 1
 
     log.info("Initializing streaming context... %s sec/batch", conf.seconds)
     ssc = StreamingContext(batch_interval=conf.seconds)
@@ -63,6 +72,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         get_state=lambda: model.latest_weights,
         set_state=model.set_initial_weights,
         totals=totals,
+        lead=lead,
     )
 
     from ..utils.tracing import Tracer
@@ -76,29 +86,36 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         mse = round_half_up(float(out.mse))
         real_stdev = round_half_up(float(out.real_stdev))
         pred_stdev = round_half_up(float(out.pred_stdev))
-        valid = batch.mask.astype(bool)
-        real = batch.label[valid].astype(np.float64)
-        pred = np.asarray(out.predictions)[valid].astype(np.float64)
-        # the reference's debug channel (LinearRegression.scala:67-74)
-        print(
-            f"count: {totals['count']}  batch: {b}  mse: {mse}  "
-            f"stdev (real, pred): ({int(real_stdev)}, {int(pred_stdev)})",
-            flush=True,
-        )
-        session.update(
-            totals["count"], b, mse, real_stdev, pred_stdev, real, pred
-        )
+        if lead:
+            # the reference's debug channel (LinearRegression.scala:67-74);
+            # stats are global (psum over the data axis) so one host prints.
+            # Per-row series are lead-local (followers don't even fetch
+            # predictions, parallel/distributed.py) and may be empty when
+            # the lead's own shard had no valid rows this batch.
+            valid = batch.mask.astype(bool)
+            real = batch.label[valid].astype(np.float64)
+            pred = np.asarray(out.predictions)[valid].astype(np.float64)
+            print(
+                f"count: {totals['count']}  batch: {b}  mse: {mse}  "
+                f"stdev (real, pred): ({int(real_stdev)}, {int(pred_stdev)})",
+                flush=True,
+            )
+            session.update(
+                totals["count"], b, mse, real_stdev, pred_stdev, real, pred
+            )
         ckpt.maybe_save(totals, at_boundary)
         if max_batches and totals["batches"] >= max_batches:
             ssc.request_stop()
 
-    flush_group, group_k = attach_super_batcher(conf, stream, model, handle)
+    flush_group, group_k = attach_super_batcher(
+        conf, stream, model, handle, stop_requested=lambda: ssc.stop_requested
+    )
 
     warmup_compile(stream, model, super_batch=group_k)
 
     log.info("Starting the streaming computation...")
     tracer.start()
-    ssc.start()
+    ssc.start(lockstep=lockstep)
     try:
         ssc.await_termination()
     except KeyboardInterrupt:
@@ -108,6 +125,11 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         flush_group()  # drain a partial superbatch group before final state
         tracer.stop()
         ckpt.final_save(totals)
+    if ssc.failed:
+        raise RuntimeError(
+            "multi-host lockstep run aborted (see critical log above); "
+            "progress up to the failure is checkpointed"
+        )
     return totals
 
 

@@ -33,6 +33,7 @@ from .common import (
     attach_super_batcher,
     build_model,
     build_source,
+    init_distributed,
     select_backend,
     warmup_compile,
 )
@@ -41,7 +42,8 @@ log = get_logger("apps.logistic")
 
 
 def run(conf: ConfArguments, max_batches: int = 0) -> dict:
-    session = SessionStats(conf).open()
+    lead = init_distributed(conf)  # before any backend use (apps/common)
+    session = SessionStats(conf).open() if lead else None
     select_backend(conf)
     featurizer = Featurizer.from_conf(conf)
     featurizer.label_fn = sentiment_label
@@ -51,6 +53,9 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     # exactly like the flagship app (the logistic residual rides the same
     # sharded step)
     model, row_multiple = build_model(conf, StreamingLogisticRegressionWithSGD)
+    import jax
+
+    lockstep = jax.process_count() > 1
 
     ssc = StreamingContext(batch_interval=conf.seconds)
     stream = ssc.source_stream(
@@ -67,6 +72,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         get_state=lambda: model.latest_weights,
         set_state=model.set_initial_weights,
         totals=totals,
+        lead=lead,
     )
 
     def handle(out, batch, _batch_time, at_boundary=True) -> None:
@@ -74,28 +80,38 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         totals["count"] += b
         totals["batches"] += 1
         err_rate = float(out.mse)  # 0/1 preds → MSE == misclassification rate
-        valid = batch.mask.astype(bool)
-        real = batch.label[valid].astype(np.float64)
-        pred = np.asarray(out.predictions)[valid].astype(np.float64)
-        print(
-            f"count: {totals['count']}  batch: {b}  errRate: {err_rate:.3f}  "
-            f"posRate (real, pred): ({real.mean():.2f}, {pred.mean():.2f})",
-            flush=True,
-        )
-        session.update(
-            totals["count"], b,
-            round_half_up(err_rate * 100),  # percent for the int dashboard field
-            round_half_up(float(out.real_stdev) * 100),
-            round_half_up(float(out.pred_stdev) * 100),
-            real, pred,
-        )
+        if lead:
+            # per-row series are lead-local (followers don't fetch
+            # predictions) and can be empty when the lead's own shard had
+            # no valid rows this batch — the GLOBAL stats above still hold
+            valid = batch.mask.astype(bool)
+            real = batch.label[valid].astype(np.float64)
+            pred = np.asarray(out.predictions)[valid].astype(np.float64)
+            rates = (
+                f"({real.mean():.2f}, {pred.mean():.2f})"
+                if real.size else "(-, -)"
+            )
+            print(
+                f"count: {totals['count']}  batch: {b}  "
+                f"errRate: {err_rate:.3f}  posRate (real, pred): {rates}",
+                flush=True,
+            )
+            session.update(
+                totals["count"], b,
+                round_half_up(err_rate * 100),  # percent for the int dashboard
+                round_half_up(float(out.real_stdev) * 100),
+                round_half_up(float(out.pred_stdev) * 100),
+                real, pred,
+            )
         ckpt.maybe_save(totals, at_boundary)
         if max_batches and totals["batches"] >= max_batches:
             ssc.request_stop()
 
-    flush_group, group_k = attach_super_batcher(conf, stream, model, handle)
+    flush_group, group_k = attach_super_batcher(
+        conf, stream, model, handle, stop_requested=lambda: ssc.stop_requested
+    )
     warmup_compile(stream, model, super_batch=group_k)
-    ssc.start()
+    ssc.start(lockstep=lockstep)
     try:
         ssc.await_termination()
     except KeyboardInterrupt:
@@ -104,6 +120,11 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         ssc.stop()
         flush_group()  # drain a partial superbatch group
         ckpt.final_save(totals)
+    if ssc.failed:
+        raise RuntimeError(
+            "multi-host lockstep run aborted (see critical log above); "
+            "progress up to the failure is checkpointed"
+        )
     return totals
 
 

@@ -135,9 +135,20 @@ class ConfArguments:
         self.faultEvery: int = int(conf.get("faultEvery", "0"))
         self.superBatch: int = int(conf.get("superBatch", "1"))
 
+        # Multi-host process group (the reference's one-flag cluster story,
+        # ConfArguments.scala:95-98 --master spark://host:port): here a
+        # jax.distributed coordinator + process coordinates, settable either
+        # via these flags or a twtml://host:port master URL.
+        self.coordinator: str = conf.get("coordinator", "")
+        self.numProcesses: int = int(conf.get("numProcesses", "0"))
+        self.processId: int = int(conf.get("processId", "-1"))
+
         # Spark-compat knobs: --master/--name are accepted for CLI parity
         # (ConfArguments.scala:95-102); master is interpreted as a backend
-        # hint ("local[N]" caps data-parallel shards on CPU).
+        # hint ("local[N]" caps data-parallel shards on CPU) or a
+        # twtml://host:port coordinator address. Unrecognized cluster
+        # schemes (spark://, mesos://, yarn) are REJECTED at validation
+        # (validate_master) — silently running single-host would be worse.
         self._appName: str = "twtml-tpu"
         self.master: str = "local[*]"
 
@@ -164,7 +175,9 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
 
   Options:
   -h, --help
-  -m, --master <master_url>                    accepted for CLI compat; local[N] caps CPU shards.
+  -m, --master <master_url>                    local[N] caps CPU shards; twtml://host:port joins
+                                               a multi-host run (same as --coordinator). Other
+                                               cluster schemes are rejected.
   -n, --name <name>                            A name of your application.
   -C, --consumerKey <consumerKey>              Twitter's consumer key
   -S, --consumerSecret <consumerSecret>        Twitter's consumer secret
@@ -181,6 +194,11 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
   -f, --numTextFeatures <integer number>       Default: {self.numTextFeatures}
 
   TPU-framework extensions:
+  --coordinator <host:port>                    Join a multi-host jax.distributed process group
+                                               (with --numProcesses/--processId; the cluster
+                                               analog of the reference's --master spark://...)
+  --numProcesses <int>                         Total processes in the multi-host group
+  --processId <int>                            This process's rank in the multi-host group
   --backend <auto|tpu|cpu>                     Default: {self.backend}
   --source <replay|twitter|synthetic>          Default: {self.source}
   --replayFile <path.jsonl>                    Tweet replay file (source=replay)
@@ -250,6 +268,12 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
             self.numRetweetEnd = int(take())
         elif flag in ("--numTextFeatures", "-f"):
             self.numTextFeatures = int(take())
+        elif flag == "--coordinator":
+            self.coordinator = take()
+        elif flag == "--numProcesses":
+            self.numProcesses = int(take())
+        elif flag == "--processId":
+            self.processId = int(take())
         elif flag == "--backend":
             self.backend = take()
         elif flag == "--source":
@@ -308,3 +332,56 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                 except ValueError:
                     return None
         return None
+
+    def validate_master(self) -> None:
+        """Resolve --master into the runtime it names. ``local``/``local[N]``
+        stay single-host; ``twtml://host:port`` is the cluster form (fills
+        --coordinator); anything else — notably the reference's
+        ``spark://host:port`` — is REJECTED: this runtime cannot honor it,
+        and silently running single-host would be worse (VERDICT r2)."""
+        m = self.master
+        if m == "local" or (m.startswith("local[") and m.endswith("]")):
+            return
+        if m.startswith("twtml://"):
+            addr = m[len("twtml://"):].rstrip("/")
+            if not addr:
+                raise SystemExit("--master twtml:// needs host:port")
+            if self.coordinator and self.coordinator != addr:
+                raise SystemExit(
+                    f"--master {m} conflicts with --coordinator "
+                    f"{self.coordinator}"
+                )
+            self.coordinator = addr
+            return
+        raise SystemExit(
+            f"unsupported --master {m!r}: this is the TPU-native runtime — "
+            "use local[N] for single-host, or twtml://host:port (equivalently "
+            "--coordinator host:port --numProcesses N --processId I) for a "
+            "multi-host jax.distributed group"
+        )
+
+    def multihost(self) -> "tuple[str, int, int] | None":
+        """(coordinator, num_processes, process_id) when a multi-host group
+        is requested; None for single-host runs. Called after
+        ``validate_master`` so twtml:// masters are folded in."""
+        if not self.coordinator:
+            if self.numProcesses > 0 or self.processId >= 0:
+                # half-specified cluster coordinates silently running
+                # single-host would double-train the stream and race
+                # checkpoint writers — reject, like bad --master schemes
+                raise SystemExit(
+                    "--numProcesses/--processId need --coordinator "
+                    "host:port (or --master twtml://host:port)"
+                )
+            return None
+        if self.numProcesses < 2 or self.processId < 0:
+            raise SystemExit(
+                "--coordinator requires --numProcesses >= 2 and "
+                "--processId >= 0 (one unique id per process)"
+            )
+        if self.processId >= self.numProcesses:
+            raise SystemExit(
+                f"--processId {self.processId} out of range for "
+                f"--numProcesses {self.numProcesses}"
+            )
+        return self.coordinator, self.numProcesses, self.processId

@@ -235,6 +235,21 @@ class Featurizer:
             text = _strip_accents(text)
         return hashing_tf_counts(char_bigrams(text), self.num_text_features)
 
+    def unit_len(self, status: Status) -> int:
+        """UTF-16 unit count the wire formats will carry for this status's
+        text — the same original-tweet/lower/accent handling as
+        ``featurize_batch_units``/``featurize_text``, kept HERE so the
+        over-long-row probe (multi-host lockstep overflow handling,
+        streaming/context.py) can never drift from the canonical encoding.
+        Unmeasurable rows count as over-long."""
+        try:
+            text = status.retweeted_status.text.lower()
+            if self.normalize_accents:
+                text = _strip_accents(text)
+            return len(text.encode("utf-16-le", "surrogatepass")) // 2
+        except Exception:
+            return 1 << 30
+
     def featurize_numbers(self, status: Status) -> np.ndarray:
         original = status.retweeted_status
         now = self.now_ms if self.now_ms is not None else int(time.time() * 1000)

@@ -310,7 +310,8 @@ def make_sgd_train_step(
                 f_text,
                 row_start=lax.axis_index(axis_name) * rows,
                 rows=rows,
-            )  # [B_local, B_global]: FLOPs scale 1/shards
+            )  # [B_local, B_global]: the G matmul's FLOPs scale 1/shards
+            # (the count build replicates per shard — see text_gram.left)
             g_text = lax.all_gather(panel, axis_name, axis=0, tiled=True)
             g = add_numeric_block(g_text, numeric, dtype)
         else:

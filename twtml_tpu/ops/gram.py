@@ -131,8 +131,12 @@ def text_gram(token_idx, token_val, f_text: int, row_start=None, rows: int = 0):
     )
 
     def left(c):
-        """The (possibly row-sliced) left operand; the slice makes the
-        matmul FLOPs scale 1/shards in sharded builds."""
+        """The (possibly row-sliced) left operand. The slice makes the G
+        MATMUL's FLOPs scale 1/shards in sharded builds; the count build
+        itself is deliberately replicated per shard — the right operand
+        needs all B_global rows anyway, and all-gathering shard-local
+        count builds would move [B_global, F_local] bf16 (~0.5 GB at the
+        2^18 operating point) to save a build worth ~3% of the G matmul."""
         if rows:
             return lax.dynamic_slice_in_dim(c, row_start, rows, axis=0)
         return c

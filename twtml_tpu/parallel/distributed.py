@@ -59,6 +59,69 @@ def initialize(
         log.debug("jax.distributed not initialized (%s); single-process mode", exc)
 
 
+class MultiHostSGDModel:
+    """Per-host sharded intake over a multi-process mesh, with the same step
+    surface the apps consume (apps/common.build_model): LOCAL host batches
+    in, host-relevant outputs back.
+
+    ``step`` assembles this host's featurized rows into the global
+    row-sharded batch (``host_local_batch_to_global``), runs the inner
+    mesh-sharded step (whose gradient psums ride ICI within a host and DCN
+    across — the treeAggregate analog, SURVEY.md §3.3), and returns a
+    StepOutput whose scalar stats are GLOBAL (psum over the whole data
+    axis, identical on every host) while ``predictions`` is localized to
+    THIS host's contributed rows — aligned with the local batch the app's
+    handler already holds, so per-row telemetry (real/pred series) stays a
+    host-local concern and no host ever fetches another host's rows."""
+
+    def __init__(self, inner, mesh):
+        self.inner = inner
+        self.mesh = mesh
+        self.num_data = inner.num_data
+        self._lead = jax.process_index() == 0
+
+    @property
+    def latest_weights(self):
+        return self.inner.latest_weights
+
+    def set_initial_weights(self, weights) -> "MultiHostSGDModel":
+        self.inner.set_initial_weights(weights)
+        return self
+
+    @staticmethod
+    def _local_rows(arr) -> np.ndarray:
+        """This process's rows of a row-sharded global array, in global row
+        order (shards sorted by their global offset). The per-shard
+        device→host copies are started async first so they overlap each
+        other; the fetch itself is still synchronous per step — a known
+        cost of the multi-host telemetry path (SCALING.md §4), not of the
+        single-host pipeline the lag fetch optimizes."""
+        shards = sorted(
+            arr.addressable_shards, key=lambda s: s.index[0].start or 0
+        )
+        for s in shards:
+            s.data.copy_to_host_async()
+        return np.concatenate([np.asarray(s.data) for s in shards])
+
+    def step(self, local_batch):
+        out = self.inner.step(
+            host_local_batch_to_global(local_batch, self.mesh)
+        )
+        # only the lead's handler consumes per-row predictions (telemetry
+        # is lead-owned); followers skip the blocking device→host fetch —
+        # each fetch is a full transport round trip (BENCHMARKS.md)
+        return out._replace(
+            predictions=(
+                self._local_rows(out.predictions) if self._lead else None
+            )
+        )
+
+    def step_many(self, stacked):
+        raise NotImplementedError(
+            "--superBatch is not wired for multi-host runs"
+        )
+
+
 def host_local_batch_to_global(
     batch: FeatureBatch | UnitBatch, mesh
 ) -> FeatureBatch | UnitBatch:

@@ -37,6 +37,28 @@ log = get_logger("streaming.context")
 BatchFn = Callable[[FeatureBatch, float], None]
 
 
+class _RowCountQueue(queue.Queue):
+    """queue.Queue that also tracks the queued ROW count (a ParsedBlock item
+    counts its rows, a Status counts 1) — maintained inside ``_put``/``_get``,
+    which run under the queue's own mutex, so the per-tweet intake path pays
+    no extra lock. The back-to-back fill gate compares ``rows_queued`` (not
+    item count) to the row bucket; reading the int without the mutex is fine
+    for a gate that only ever errs toward one more 2 ms wait."""
+
+    def _init(self, maxsize: int) -> None:
+        super()._init(maxsize)
+        self.rows_queued = 0
+
+    def _put(self, item) -> None:
+        super()._put(item)
+        self.rows_queued += getattr(item, "rows", 1)
+
+    def _get(self):
+        item = super()._get()
+        self.rows_queued -= getattr(item, "rows", 1)
+        return item
+
+
 class RawStream:
     """A stream of raw Status lists — for apps with their own featurization
     (the k-means entry featurizes to a dense pair, KMeans.scala:19-33).
@@ -82,29 +104,40 @@ class FeatureStream(RawStream):
             pad_row_count(0, row_bucket, row_multiple) if row_bucket > 0 else 0
         )
 
-    def _check_buckets(self, batch) -> None:
-        """Warn (once) when a batch overflowed the pinned buckets: the
-        featurizer grows the bucket rather than truncate, so the step
-        recompiles for the bigger shape — silently defeating a pre-stream
-        compile warmup and multiplying program count."""
-        if self._bucket_overflow_warned:
-            return
-        rows = batch.mask.shape[0]
+    @staticmethod
+    def batch_shape(batch) -> "tuple[int, int]":
+        """(rows, tokens-or-units) of a featurized batch — the two axes the
+        pinned buckets govern."""
         tokens = (
             batch.units.shape[1]
             if isinstance(batch, UnitBatch)
             else batch.token_idx.shape[1]
         )
-        over_rows = 0 < self._pinned_rows < rows
-        over_tok = 0 < self.token_bucket < tokens
-        if over_rows or over_tok:
-            self._bucket_overflow_warned = True
-            log.warning(
-                "batch shape (%d, %d) overflowed the pinned buckets "
-                "(%d, %d): the step recompiles for the larger shape — "
-                "raise --batchBucket/--tokenBucket to keep one program",
-                rows, tokens, self.row_bucket, self.token_bucket,
-            )
+        return batch.mask.shape[0], tokens
+
+    def bucket_overflow(self, batch) -> bool:
+        """Whether a featurized batch outgrew the pinned buckets (the
+        featurizer grows rather than truncates)."""
+        rows, tokens = self.batch_shape(batch)
+        return (0 < self._pinned_rows < rows) or (
+            0 < self.token_bucket < tokens
+        )
+
+    def _check_buckets(self, batch) -> None:
+        """Warn (once) when a batch overflowed the pinned buckets: the
+        featurizer grows the bucket rather than truncate, so the step
+        recompiles for the bigger shape — silently defeating a pre-stream
+        compile warmup and multiplying program count."""
+        if self._bucket_overflow_warned or not self.bucket_overflow(batch):
+            return
+        self._bucket_overflow_warned = True
+        rows, tokens = self.batch_shape(batch)
+        log.warning(
+            "batch shape (%d, %d) overflowed the pinned buckets "
+            "(%d, %d): the step recompiles for the larger shape — "
+            "raise --batchBucket/--tokenBucket to keep one program",
+            rows, tokens, self.row_bucket, self.token_bucket,
+        )
 
     def _featurize(self, statuses: list) -> "FeatureBatch | UnitBatch":
         """The ONE featurize dispatch for this stream's configuration —
@@ -152,13 +185,16 @@ class FeatureStream(RawStream):
 class StreamingContext:
     def __init__(self, batch_interval: float = 5.0):
         self.batch_interval = batch_interval
-        self._queue: "queue.Queue[Status]" = queue.Queue()
+        self._queue: _RowCountQueue = _RowCountQueue()
         self._source: Source | None = None
         self._stream: RawStream | None = None
         self._scheduler: threading.Thread | None = None
         self._stop = threading.Event()
         self._terminated = threading.Event()
         self.batches_processed = 0
+        # set when a lockstep run aborted (this host or a peer): the app
+        # must surface a failure instead of reporting success
+        self.failed = False
 
     def source_stream(
         self,
@@ -228,7 +264,7 @@ class StreamingContext:
             if delay > 0 and self._stop.wait(delay):
                 break
             next_tick += self.batch_interval
-            if limit and self._queue.qsize() < limit and not self._source.exhausted:
+            if limit and self._queue.rows_queued < limit and not self._source.exhausted:
                 # fill the bucket before processing: batch boundaries stay
                 # deterministic (full buckets + one tail) instead of racing
                 # the producer — the run_to_completion contract
@@ -244,15 +280,151 @@ class StreamingContext:
         early-exit hook apps use for max-batches caps."""
         self._stop.set()
 
+    @property
+    def stop_requested(self) -> bool:
+        """Whether a stop has been requested (read by the lagged-fetch
+        pipeline to honor max-batches caps exactly, apps/common.py)."""
+        return self._stop.is_set()
+
+    def _run_batch_aligned(self, statuses: list[Status], batch_time: float) -> None:
+        """Lockstep-mode batch: host-local failures must never change this
+        host's COLLECTIVE program sequence (the other hosts' psums would
+        block forever on the missing program). A featurize failure — purely
+        host-side, nothing dispatched yet — substitutes the all-padding
+        batch (rows lost, loudly). A shape overflow of the pinned buckets
+        would dispatch a DIFFERENTLY-SHAPED program than the peers', so it
+        is a hard error. Output (dispatch/handler) exceptions propagate to
+        the loop: after a possible partial dispatch alignment is unknowable,
+        and failing fast beats a distributed hang."""
+        stream = self._stream
+        try:
+            batch = stream._featurize(statuses)
+        except Exception:
+            log.exception(
+                "featurize failed in lockstep mode; substituting an "
+                "all-padding batch to keep the group's collective sequence "
+                "aligned (these rows are lost)"
+            )
+            batch = stream._featurize([])
+        if stream.bucket_overflow(batch):
+            # single-host runs grow the bucket and recompile (benign); here
+            # a grown shape means THIS host dispatches a differently-shaped
+            # collective program than its peers → distributed hang. The
+            # overflow is data-dependent (one long tweet), so it must not
+            # kill the run either: drop the over-long rows, keep the rest.
+            # conservative probe: the featurizer owns the canonical text
+            # encoding (host-hash wire carries units-1 bigram tokens, so
+            # <= token_bucket under-admits by at most one unit there)
+            kept = [
+                s for s in statuses
+                if stream.featurizer.unit_len(s) <= stream.token_bucket
+            ]
+            rows, tokens = stream.batch_shape(batch)
+            log.error(
+                "batch shape (%d, %d) overflowed the pinned buckets "
+                "(%d, %d) in a multi-host run; dropping %d over-long row(s) "
+                "to keep the group's program shapes aligned — raise "
+                "--batchBucket/--tokenBucket", rows, tokens,
+                stream.row_bucket, stream.token_bucket,
+                len(statuses) - len(kept),
+            )
+            batch = stream._featurize(kept)
+            if stream.bucket_overflow(batch):
+                # probe missed (e.g. a case fold changed the length):
+                # last resort keeps alignment at the cost of the batch
+                log.error("overflow persists; dropping the whole batch")
+                batch = stream._featurize([])
+        for fn in stream._outputs:
+            fn(batch, batch_time)
+        self.batches_processed += 1
+
+    def _lockstep_loop(self) -> None:
+        """Multi-host batch scheduler: every process must run the SAME
+        sequence of collective programs, so batch cadence and termination
+        are agreed per tick with one tiny all-process allgather of
+        (has_rows, more_coming, abort). A host whose intake shard ran dry
+        keeps dispatching all-padding batches (zero-sample steps are weight
+        no-ops) until EVERY host is exhausted — otherwise the other hosts'
+        psums would wait forever on its missing program.
+
+        A batch failure AFTER featurize leaves this host's collective
+        alignment unknowable, so it stops dispatching — but it keeps
+        ticking the allgather with abort=1 until every peer has seen it
+        (peers then stop too instead of stalling in their next collective),
+        and the run is marked ``failed`` so the app can exit non-zero
+        rather than report success.
+
+        Drains are capped at the row bucket in BOTH modes (wall-clock rows
+        beyond the bucket stay queued for the next tick): an uncapped drain
+        could exceed --batchBucket and grow this host's program shape away
+        from its peers'."""
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        limit = getattr(self._stream, "row_bucket", 0)
+        next_tick = time.monotonic() + self.batch_interval
+        aborting = False
+        while not self._stop.is_set():
+            if self.batch_interval > 0 and not aborting:
+                delay = next_tick - time.monotonic()
+                if delay > 0 and self._stop.wait(delay):
+                    break
+                next_tick += self.batch_interval
+            elif limit and not aborting:
+                # back-to-back fill gate, as in _scheduler_loop
+                while (
+                    self._queue.rows_queued < limit
+                    and not self._source.exhausted
+                    and not self._stop.is_set()
+                ):
+                    self._stop.wait(0.002)
+            local = self._drain(limit)
+            rows = sum(getattr(s, "rows", 1) for s in local)
+            more = (not self._source.exhausted) or self._queue.rows_queued > 0
+            flags = multihost_utils.process_allgather(
+                np.array(
+                    [rows > 0 and not aborting, more and not aborting,
+                     aborting],
+                    dtype=np.int32,
+                )
+            )
+            if flags[:, 2].any():
+                # this host (or a peer) aborted: everyone has now agreed on
+                # it in the same tick, so everyone can stop dispatching
+                if not aborting:
+                    log.critical("a peer host aborted the lockstep run")
+                self.failed = True
+                break
+            if flags[:, 0].any():
+                # somebody has rows: EVERY host dispatches (local may be
+                # empty — it pads to the pinned bucket)
+                try:
+                    self._run_batch_aligned(local, time.time())
+                except Exception:
+                    log.critical(
+                        "lockstep batch failed after featurize; this host's "
+                        "collective alignment is unknowable — aborting the "
+                        "group (fail fast beats a distributed hang)",
+                        exc_info=True,
+                    )
+                    aborting = True  # next tick broadcasts abort to peers
+            if not aborting and not (flags[:, 0].any() or flags[:, 1].any()):
+                break
+        self._terminated.set()
+
     # -- lifecycle (ssc.start/awaitTermination, LinearRegression.scala:89-91) --
-    def start(self) -> None:
+    def start(self, lockstep: bool = False) -> None:
+        """``lockstep=True`` (multi-host runs) replaces the local scheduler
+        with the collectively-agreed one (``_lockstep_loop``)."""
         if self._stream is None:
             raise ValueError("no stream registered")
         self._stop.clear()
         self._terminated.clear()
+        self.failed = False
         self._source.start(self._queue.put)
         self._scheduler = threading.Thread(
-            target=self._scheduler_loop, name="twtml-batch-scheduler", daemon=True
+            target=self._lockstep_loop if lockstep else self._scheduler_loop,
+            name="twtml-batch-scheduler", daemon=True,
         )
         self._scheduler.start()
 

@@ -359,6 +359,30 @@ class SyntheticSource(Source):
                 return
 
 
+class ShardedSource(Source):
+    """Take items ``index``-of-``count`` (round-robin) from an inner source —
+    the per-host intake shard of a multi-host run (SURVEY.md §7 stage 5):
+    every host opens the same replay/synthetic source and keeps 1/N of the
+    stream, so the union of all hosts' shards is exactly the single-host
+    stream and host i's k-th batch interleaves with the others into the
+    same global row set a single-host run would batch."""
+
+    name = "shard"
+
+    def __init__(self, inner: Source, index: int, count: int, **kw):
+        super().__init__(**kw)
+        if not 0 <= index < count:
+            raise ValueError(f"shard index {index} out of range for {count}")
+        self.inner = inner
+        self.index = index
+        self.count = count
+
+    def produce(self) -> Iterator[Status]:
+        for i, status in enumerate(self.inner.produce()):
+            if i % self.count == self.index:
+                yield status
+
+
 class MultiSource(Source):
     """Sharded receiver fan-in: run N inner sources concurrently into one
     stream. The reference is hard-wired to a single Twitter4j receiver
