@@ -1,7 +1,8 @@
 """Headline benchmark: streaming tweets/sec ingested+trained.
 
-Measures the full pipeline (host featurization → padded batch → fused
-predict+stats+train device step) on the attached accelerator, against the
+Measures the full pipeline (host featurization → ragged units wire → fused
+re-pad+hash+predict+stats+train device step) on the attached accelerator,
+against the
 BASELINE.md metric "tweets/sec ingested+trained". The reference publishes no
 numbers (BASELINE.json ``published: {}``), so the baseline is measured in the
 same process family: the identical pipeline forced onto the CPU backend in a
@@ -70,10 +71,14 @@ def measure(
     chunks = [statuses[i : i + batch_size] for i in range(0, n_tweets, batch_size)]
 
     def featurize(chunk):
-        # on-device featurization wire format: the host encodes + pads raw
-        # code units; bigram hashing happens inside the fused device step
-        # (bit-identical features — tests/test_device_hash.py)
-        return feat.featurize_batch_units(
+        # ragged device wire (r3): the host encodes raw code units and
+        # ships them CONCATENATED (no per-row pad bytes on the
+        # upload-bound transport — 53% of the padded buffer was padding);
+        # the fused device step re-pads with one gather and hashes bigrams
+        # in-program. Bit-identical features (tests/test_ragged_wire.py,
+        # test_device_hash.py); measured +14% paired vs the padded wire
+        # over 76 interleaved passes (tools/bench_ragged.py, BENCHMARKS.md)
+        return feat.featurize_batch_ragged(
             chunk, row_bucket=batch_size, pre_filtered=True
         )
 

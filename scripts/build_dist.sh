@@ -6,6 +6,11 @@ set -e
 version="0.1.0"
 cd "$(dirname "$0")/.."
 rm -rf target && mkdir -p target
+# minify dashboard assets (the reference's sbt-uglify step, web/build.sbt:25-39);
+# the server serves file.min.js when present (web/server.py)
+python tools/jsminify.py twtml_tpu/web/assets/js/api.js \
+    twtml_tpu/web/assets/js/index.js twtml_tpu/web/assets/js/chart.js \
+    twtml_tpu/web/assets/js/test.js
 zip -qr "target/twtml-tpu-${version}.zip" \
     twtml_tpu native pyproject.toml README.md bench.py \
     -x "*/__pycache__/*" -x "*.so"

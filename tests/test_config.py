@@ -239,3 +239,13 @@ def test_half_specified_cluster_coordinates_rejected(isolated_env):
     conf = ConfArguments().parse(["--numProcesses", "2", "--processId", "0"])
     with pytest.raises(SystemExit):
         conf.multihost()
+
+
+def test_float64_requires_cpu_backend(isolated_env):
+    # --dtype float64 is the CPU verification dtype; TPU has no f64 path
+    # and silently downcasting would make the flag lie (apps/common)
+    from twtml_tpu.apps.common import select_backend
+
+    conf = ConfArguments().parse(["--dtype", "float64"])
+    with pytest.raises(SystemExit):
+        select_backend(conf)  # backend auto: must demand --backend cpu

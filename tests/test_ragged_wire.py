@@ -1,0 +1,186 @@
+"""Ragged units wire (features/batch.RaggedUnitBatch): the concatenated
+units + offsets wire must produce BIT-IDENTICAL training to the padded
+UnitBatch wire — the device-side gather re-pad + ASCII fold replaces the
+host-side pad copy exactly. Parity law: features/hashing.py / the padded
+Status path is ground truth; every fast path carries differential tests."""
+
+import numpy as np
+import pytest
+
+from twtml_tpu.features.batch import RAGGED_UNIT_MULTIPLE, RaggedUnitBatch
+from twtml_tpu.features.featurizer import Featurizer, Status
+from twtml_tpu.models import (
+    StreamingLinearRegressionWithSGD,
+    StreamingLogisticRegressionWithSGD,
+)
+from twtml_tpu.streaming.sources import SyntheticSource
+
+
+def rt(text, label=500):
+    return Status(
+        text="RT",
+        retweeted_status=Status(text=text, retweet_count=label,
+                                followers_count=1234),
+    )
+
+
+def synthetic(n=96, seed=13):
+    return list(
+        SyntheticSource(total=n, seed=seed, base_ms=1785320000000).produce()
+    )
+
+
+def assert_identical_training(statuses, model_cls=StreamingLinearRegressionWithSGD,
+                              rows=32, feat_kw=None, model_kw=None):
+    feat = Featurizer(now_ms=1785320000000, **(feat_kw or {}))
+    chunks = [statuses[i : i + rows] for i in range(0, len(statuses), rows)]
+
+    padded_model = model_cls(num_iterations=5, **(model_kw or {}))
+    ragged_model = model_cls(num_iterations=5, **(model_kw or {}))
+    for chunk in chunks:
+        pb = feat.featurize_batch_units(chunk, row_bucket=rows, unit_bucket=64)
+        rb = feat.featurize_batch_ragged(chunk, row_bucket=rows, unit_bucket=64)
+        out_p = padded_model.step(pb)
+        out_r = ragged_model.step(rb)
+        for field_p, field_r in zip(out_p, out_r):
+            np.testing.assert_array_equal(
+                np.asarray(field_p), np.asarray(field_r)
+            )
+    np.testing.assert_array_equal(
+        padded_model.latest_weights, ragged_model.latest_weights
+    )
+
+
+def test_ragged_matches_padded_synthetic_stream():
+    assert_identical_training(synthetic())
+
+
+def test_ragged_matches_padded_logistic():
+    assert_identical_training(
+        synthetic(), model_cls=StreamingLogisticRegressionWithSGD
+    )
+
+
+def test_ragged_matches_padded_unicode_and_edge_rows():
+    statuses = [
+        rt("MiXeD CaSe ASCII tweet!"),
+        rt("ünïcode ÉMOJI \U0001f600 tweet"),  # astral char: 2 units
+        rt("x"),  # single-unit row: the sliding(2) special case
+        rt("ÀÈÌ UPPER with accents"),
+        rt("plain lower ascii"),
+    ] * 7
+    assert_identical_training(statuses, rows=8)
+    assert_identical_training(
+        statuses, rows=8, feat_kw={"normalize_accents": True}
+    )
+
+
+def test_ragged_wire_shape_and_narrowing():
+    feat = Featurizer(now_ms=0)
+    rb = feat.featurize_batch_ragged(
+        [rt("hello world")] * 10, row_bucket=16, unit_bucket=32
+    )
+    assert isinstance(rb, RaggedUnitBatch)
+    assert rb.units.dtype == np.uint8  # all-ASCII narrow wire
+    assert rb.units.shape == (RAGGED_UNIT_MULTIPLE,)
+    assert rb.offsets.shape == (17,)
+    assert rb.row_len == 32
+    assert rb.num_valid == 10
+    # non-ASCII rows keep the full uint16 schema
+    rb16 = feat.featurize_batch_ragged([rt("héllo")] * 4, row_bucket=8)
+    assert rb16.units.dtype == np.uint16
+
+
+def test_ragged_empty_batch():
+    feat = Featurizer(now_ms=0)
+    rb = feat.featurize_batch_ragged([], row_bucket=8, unit_bucket=16)
+    model = StreamingLinearRegressionWithSGD(num_iterations=5)
+    out = model.step(rb)
+    assert float(out.count) == 0.0
+    np.testing.assert_array_equal(
+        model.latest_weights, np.zeros_like(model.latest_weights)
+    )
+
+
+def test_ragged_2e18_gram_config():
+    """The ragged wire through the 2^18 Gram-domain config (BASELINE #4) —
+    the config whose throughput the wire work targets."""
+    statuses = synthetic(n=64)
+    assert_identical_training(
+        statuses, rows=32,
+        feat_kw={"num_text_features": 2**18},
+        model_kw={"num_text_features": 2**18, "l2_reg": 0.1},
+    )
+
+
+@pytest.mark.parametrize("total", [3, 40])
+def test_ragged_unit_bucket_growth(total):
+    """Unpinned unit bucket: the rebuilt row length grows per batch like the
+    padded wire's (same _bucket policy), so mixed streams stay consistent."""
+    feat = Featurizer(now_ms=0)
+    text = "a" * total
+    rb = feat.featurize_batch_ragged([rt(text)], row_bucket=4)
+    pb = feat.featurize_batch_units([rt(text)], row_bucket=4)
+    assert rb.row_len == pb.units.shape[1]
+
+
+def test_linear_app_ragged_identical_stats(tmp_path, capsys):
+    """--wire ragged through the REAL flagship app prints the identical
+    per-batch stats lines and totals as --wire padded."""
+    import json
+
+    import jax
+
+    from tools.bench_suite import _status_json
+    from twtml_tpu.apps import linear_regression as app
+    from twtml_tpu.config import ConfArguments
+
+    jax.devices()  # lock the conftest backend before local[1]
+
+    path = tmp_path / "tweets.jsonl"
+    with open(path, "w") as fh:
+        for s in synthetic(n=5 * 16, seed=21):
+            fh.write(json.dumps(_status_json(s)) + "\n")
+
+    def run(wire):
+        conf = ConfArguments().parse([
+            "--source", "replay", "--replayFile", str(path),
+            "--seconds", "0", "--backend", "cpu",
+            "--batchBucket", "16", "--tokenBucket", "64",
+            "--master", "local[1]", "--wire", wire,
+        ])
+        capsys.readouterr()
+        totals = app.run(conf)
+        lines = [
+            ln for ln in capsys.readouterr().out.splitlines()
+            if ln.startswith("count:")
+        ]
+        return totals, lines
+
+    totals_p, lines_p = run("padded")
+    totals_r, lines_r = run("ragged")
+    assert totals_r == totals_p
+    assert lines_r == lines_p
+    assert len(lines_p) >= 5
+
+
+def test_ragged_flag_gates():
+    """The loud incompatibility gates: mesh, superbatch, host hashing,
+    block ingest."""
+    from twtml_tpu.apps.common import build_model, build_source
+    from twtml_tpu.config import ConfArguments
+
+    import jax
+
+    jax.devices()
+
+    base = ["--wire", "ragged", "--source", "synthetic"]
+    with pytest.raises(SystemExit):
+        build_model(ConfArguments().parse(base))  # 8-device mesh
+    with pytest.raises(SystemExit):
+        build_source(ConfArguments().parse(base + ["--hashOn", "host"]))
+    with pytest.raises(SystemExit):
+        build_source(ConfArguments().parse([
+            "--wire", "ragged", "--source", "replay", "--replayFile", "x",
+            "--ingest", "block",
+        ]), allow_block=True)

@@ -1,0 +1,149 @@
+"""Config #4 (hashing_2e18_l2) operating-point sweep — VERDICT r2 #4.
+
+The 2^18 Gram-domain step is device-bound at batch 2048 (~21 ms: the
+G = Z·Zᵀ matmul is ~2.2 TFLOP, ~53% of bf16 peak — BENCHMARKS.md). But the
+G build costs B²·F FLOPs, i.e. PER-TWEET device cost scales linearly with
+batch size, so a smaller batch trades per-batch overheads for less G work
+per tweet. This tool interleaves arms (batch size × wire × superbatch)
+within one window — single passes round-robin, so tunnel phase swings hit
+every arm equally — and reports each arm's best/median plus per-round
+rates, to pick the config #4 operating point from data.
+
+Usage: python tools/bench_2e18.py [--tweets N] [--budget S]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+F_TEXT = 2**18
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    n_tweets, budget = 65536, 240.0
+    i = 0
+    while i < len(args):
+        if args[i] == "--tweets":
+            n_tweets = int(args[i + 1]); i += 2
+        elif args[i] == "--budget":
+            budget = float(args[i + 1]); i += 2
+        else:
+            raise SystemExit(f"unknown flag {args[i]!r}")
+
+    import jax
+
+    from twtml_tpu.features.batch import stack_batches
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.models import StreamingLinearRegressionWithSGD
+    from twtml_tpu.streaming.sources import SyntheticSource
+    from twtml_tpu.utils.benchloop import _run_once
+
+    feat = Featurizer(num_text_features=F_TEXT, now_ms=1785320000000)
+    statuses = list(SyntheticSource(total=n_tweets, seed=3).produce())
+
+    def chunked(b):
+        return [statuses[i : i + b] for i in range(0, len(statuses), b)]
+
+    def model():
+        return StreamingLinearRegressionWithSGD(
+            num_text_features=F_TEXT, l2_reg=0.1
+        )
+
+    arms: dict = {}
+
+    def pipeline_arm(name, batch, wire):
+        chunks = chunked(batch)
+        fz = (
+            (lambda c: feat.featurize_batch_ragged(
+                c, row_bucket=batch, pre_filtered=True))
+            if wire == "ragged"
+            else (lambda c: feat.featurize_batch_units(
+                c, row_bucket=batch, pre_filtered=True))
+        )
+        m = model()
+        for _ in range(2):
+            float(m.step(fz(chunks[0])).mse)  # completion-fetch warmup
+
+        def one_pass(m=m, fz=fz, chunks=chunks):
+            m.reset()
+            return _run_once(m, fz, chunks, prefetch=True)
+
+        arms[name] = one_pass
+
+    def superbatch_arm(name, batch, k):
+        # K batches stacked into one step_many dispatch (padded wire —
+        # ragged doesn't stack); featurize+stack on a prefetch thread
+        from concurrent.futures import ThreadPoolExecutor
+
+        chunks = chunked(batch)
+        groups = [chunks[i : i + k] for i in range(0, len(chunks), k)]
+
+        def fz(group):
+            return stack_batches([
+                feat.featurize_batch_units(
+                    c, row_bucket=batch, pre_filtered=True
+                )
+                for c in group
+            ])
+
+        m = model()
+        warm = fz(groups[0])
+        for _ in range(2):
+            float(m.step_many(warm).mse[-1])
+
+        def one_pass():
+            m.reset()
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=1) as pool:
+                pending = pool.submit(fz, groups[0])
+                for nxt in groups[1:]:
+                    stacked = pending.result()
+                    pending = pool.submit(fz, nxt)
+                    m.step_many(stacked)
+                last = m.step_many(pending.result())
+            float(last.mse[-1])  # completion fetch closes the window
+            return time.perf_counter() - t0, last
+
+        arms[name] = one_pass
+
+    pipeline_arm("padded_b2048", 2048, "padded")  # the r2 operating point
+    pipeline_arm("ragged_b2048", 2048, "ragged")
+    pipeline_arm("ragged_b1024", 1024, "ragged")
+    pipeline_arm("ragged_b512", 512, "ragged")
+    pipeline_arm("padded_b1024", 1024, "padded")
+    superbatch_arm("padded_b2048_k8", 2048, 8)
+
+    times: dict[str, list] = {k: [] for k in arms}
+    t_end = time.perf_counter() + budget
+    while time.perf_counter() < t_end:
+        for name, run in arms.items():
+            dt, _ = run()
+            times[name].append(dt)
+
+    out = {"config": "hashing_2e18_l2_sweep", "tweets": n_tweets,
+           "backend": jax.default_backend(), "rounds": len(times["padded_b2048"])}
+    for name, ts in times.items():
+        out[name] = {
+            "best": round(n_tweets / min(ts), 1),
+            "median": round(n_tweets / statistics.median(ts), 1),
+        }
+    base = times["padded_b2048"]
+    for name, ts in times.items():
+        if name != "padded_b2048":
+            out[name]["paired_speedup_median"] = round(
+                statistics.median([b / t for b, t in zip(base, ts)]), 3
+            )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

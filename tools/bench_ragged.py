@@ -1,0 +1,137 @@
+"""Wire-padding measurement: padded UnitBatch vs ragged RaggedUnitBatch.
+
+VERDICT r2 #3: the padded [B, L_bucket] units buffer is the dominant wire
+tensor and nothing measured what fraction of it is padding. This tool
+reports, for a corpus at a given batch size:
+
+  - the padding fraction of the padded units buffer (1 - Σlen / B·L);
+  - wire bytes per batch for both formats (all five arrays);
+  - the pipelined end-to-end rate (utils/benchloop.measure_pipeline —
+    dispatch freely, one completion fetch per pass, best-of under a time
+    budget) for both formats, on the current backend.
+
+Usage: python tools/bench_ragged.py [--tweets N] [--batch B] [--budget S]
+       [--config dense|2e18]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def wire_bytes(batch) -> int:
+    import jax
+
+    return sum(
+        leaf.nbytes for leaf in jax.tree_util.tree_leaves(batch)
+    )
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    n_tweets, batch_size, budget, config = 65536, 2048, 45.0, "dense"
+    i = 0
+    while i < len(args):
+        if args[i] == "--tweets":
+            n_tweets = int(args[i + 1]); i += 2
+        elif args[i] == "--batch":
+            batch_size = int(args[i + 1]); i += 2
+        elif args[i] == "--budget":
+            budget = float(args[i + 1]); i += 2
+        elif args[i] == "--config":
+            config = args[i + 1]; i += 2
+        else:
+            raise SystemExit(f"unknown flag {args[i]!r}")
+
+    import jax
+    import numpy as np
+
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.models import StreamingLinearRegressionWithSGD
+    from twtml_tpu.streaming.sources import SyntheticSource
+    from twtml_tpu.utils.benchloop import measure_pipeline
+
+    f_text = 2**18 if config == "2e18" else 1000
+    feat = Featurizer(num_text_features=f_text, now_ms=1785320000000)
+    statuses = list(SyntheticSource(total=n_tweets, seed=3).produce())
+    chunks = [
+        statuses[i : i + batch_size]
+        for i in range(0, len(statuses), batch_size)
+    ]
+
+    # ---- wire accounting on the first full chunk -------------------------
+    pb = feat.featurize_batch_units(chunks[0], row_bucket=batch_size,
+                                    pre_filtered=True)
+    rb = feat.featurize_batch_ragged(chunks[0], row_bucket=batch_size,
+                                     pre_filtered=True)
+    real_units = int(np.asarray(rb.offsets)[-1])
+    padded_units = int(pb.units.shape[0] * pb.units.shape[1])
+    out = {
+        "config": config,
+        "batch": batch_size,
+        "units_padding_fraction": round(1 - real_units / padded_units, 4),
+        "padded_wire_bytes": wire_bytes(pb),
+        "ragged_wire_bytes": wire_bytes(rb),
+        "unit_dtype": str(pb.units.dtype),
+        "backend": jax.default_backend(),
+    }
+
+    # ---- pipelined end-to-end rates, INTERLEAVED -------------------------
+    # The tunnel's health swings on ~10-minute phases (BENCHMARKS.md), so
+    # sequential per-format blocks confound format with phase: alternate
+    # single passes A/B/A/B inside one window and compare paired samples.
+    import statistics
+    import time as _time
+
+    from twtml_tpu.utils.benchloop import _run_once
+
+    def make(featurize):
+        model = StreamingLinearRegressionWithSGD(
+            num_text_features=f_text, l2_reg=0.1 if config == "2e18" else 0.0
+        )
+        warm = featurize(chunks[0])
+        for _ in range(2):
+            float(model.step(warm).mse)  # completion-fetch warmup
+        return model, featurize
+
+    arms = {
+        "padded": make(lambda c: feat.featurize_batch_units(
+            c, row_bucket=batch_size, pre_filtered=True)),
+        "ragged": make(lambda c: feat.featurize_batch_ragged(
+            c, row_bucket=batch_size, pre_filtered=True)),
+    }
+    n = sum(len(c) for c in chunks)
+    times: dict[str, list] = {k: [] for k in arms}
+    finals: dict[str, float] = {}
+    t_end = _time.perf_counter() + budget
+    while _time.perf_counter() < t_end:
+        for name, (model, featurize) in arms.items():
+            model.reset()
+            dt, last = _run_once(model, featurize, chunks, prefetch=True)
+            times[name].append(dt)
+            finals[name] = round(float(last.mse), 3)
+    for name, ts in times.items():
+        out[name] = {
+            "tweets_per_sec": round(n / min(ts), 1),
+            "median_tweets_per_sec": round(n / statistics.median(ts), 1),
+            "passes": len(ts),
+            "final_mse": finals[name],
+        }
+    # paired per-round ratios: phase-robust (each pair shares a window)
+    ratios = [p / r for p, r in zip(times["padded"], times["ragged"])]
+    out["paired_speedup_median"] = round(statistics.median(ratios), 3)
+    out["paired_speedup_all"] = [round(x, 3) for x in ratios]
+    assert out["padded"]["final_mse"] == out["ragged"]["final_mse"], (
+        "wire formats diverged — parity violation"
+    )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

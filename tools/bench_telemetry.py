@@ -1,0 +1,105 @@
+"""Per-batch-telemetry regime: synchronous stats fetch vs the one-batch-lag
+pipeline (apps/common.LagPipeline — VERDICT r2 #2).
+
+The production apps read the full StepOutput every batch for the stats
+plane; through this build's tunnel each host fetch is a ~70-100 ms round
+trip, capping the back-to-back telemetry-on rate far below the free-
+dispatch rate. The lag pipeline dispatches batch k, then fetches k-1
+(whose device→host copy started at its dispatch), so the round trip
+overlaps the next batch's work. Arms interleave within one window; paired
+per-round ratios are the phase-robust comparison.
+
+Usage: python tools/bench_telemetry.py [--tweets N] [--batch B] [--budget S]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    n_tweets, batch, budget = 65536, 2048, 180.0
+    i = 0
+    while i < len(args):
+        if args[i] == "--tweets":
+            n_tweets = int(args[i + 1]); i += 2
+        elif args[i] == "--batch":
+            batch = int(args[i + 1]); i += 2
+        elif args[i] == "--budget":
+            budget = float(args[i + 1]); i += 2
+        else:
+            raise SystemExit(f"unknown flag {args[i]!r}")
+
+    import jax
+
+    from twtml_tpu.apps.common import LagPipeline
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.models import StreamingLinearRegressionWithSGD
+    from twtml_tpu.streaming.sources import SyntheticSource
+
+    feat = Featurizer(now_ms=1785320000000)
+    statuses = list(SyntheticSource(total=n_tweets, seed=3).produce())
+    chunks = [statuses[i : i + batch] for i in range(0, len(statuses), batch)]
+    batches = [
+        feat.featurize_batch_units(c, row_bucket=batch, pre_filtered=True)
+        for c in chunks
+    ]
+
+    def consume(out, b, t, at_boundary=True):
+        # what the app handlers do: read every StepOutput field on host
+        float(out.count); float(out.mse)
+        float(out.real_stdev); float(out.pred_stdev)
+        _ = out.predictions[0]
+
+    model = StreamingLinearRegressionWithSGD()
+    for _ in range(2):
+        float(model.step(batches[0]).mse)  # warm the program
+
+    def sync_pass():
+        model.reset()
+        t0 = time.perf_counter()
+        for b in batches:
+            consume(jax.device_get(model.step(b)), b, 0.0)
+        return time.perf_counter() - t0
+
+    def lag_pass():
+        model.reset()
+        pipe = LagPipeline(model, consume)
+        t0 = time.perf_counter()
+        for b in batches:
+            pipe.on_batch(b, 0.0)
+        pipe.flush()
+        return time.perf_counter() - t0
+
+    times = {"sync": [], "lag": []}
+    t_end = time.perf_counter() + budget
+    while time.perf_counter() < t_end:
+        times["sync"].append(sync_pass())
+        times["lag"].append(lag_pass())
+
+    out = {"regime": "per-batch-telemetry", "batch": batch,
+           "tweets": n_tweets, "backend": jax.default_backend(),
+           "rounds": len(times["sync"])}
+    for name, ts in times.items():
+        out[name] = {
+            "tweets_per_sec_best": round(n_tweets / min(ts), 1),
+            "tweets_per_sec_median": round(n_tweets / statistics.median(ts), 1),
+        }
+    out["paired_speedup_median"] = round(
+        statistics.median([s / l for s, l in zip(times["sync"], times["lag"])]),
+        3,
+    )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

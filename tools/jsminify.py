@@ -1,0 +1,92 @@
+"""Asset minifier — the analog of the reference's sbt-uglify pipeline
+(web/build.sbt:25-39, the one declared asset-pipeline step without an
+analog until r3).
+
+Token-level whitespace/comment stripper built on jsmini's tokenizer (which
+already drops comments): tokens re-emit per ORIGINAL source line, so
+line-break placement — and with it ASI semantics (``return\\nexpr``) —
+cannot change; only indentation, inter-token spaces, and comments go.
+Every minification self-verifies: the output must re-tokenize to the
+identical token stream (kind + value), or this raises.
+
+Usage: python tools/jsminify.py file.js [...]   # writes file.min.js next to each
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.jsmini import tokenize  # noqa: E402
+
+_WORD = lambda c: c.isalnum() or c in "_$"  # noqa: E731
+
+
+def _emit(tok) -> str:
+    if tok.kind == "str":
+        return json.dumps(tok.value)  # valid JS string literal
+    if tok.kind == "num":
+        v = tok.value
+        if float(v).is_integer() and abs(v) < 2**53:
+            return str(int(v))
+        return repr(v)
+    if tok.kind == "regex":
+        body, flags = tok.value
+        return f"/{body}/{flags}"
+    return str(tok.value)
+
+
+def _needs_space(a: str, b: str) -> bool:
+    if _WORD(a[-1]) and _WORD(b[0]):
+        return True  # e.g. `var x`, `in x`, `3 in`
+    if a[-1] in "+-" and b[0] == a[-1]:
+        return True  # `+ ++x` must not become `+++x`
+    if a[-1] == "/" and b[0] in "/*":
+        return True  # never form a comment
+    return False
+
+
+def minify(src: str) -> str:
+    tokens = tokenize(src)[:-1]  # drop eof
+    pieces: list[str] = []
+    buf: list[str] = []
+    last_line = None
+    for tok in tokens:
+        if tok.line != last_line:
+            if buf:
+                pieces.append("".join(buf))
+            buf, last_line = [], tok.line
+        s = _emit(tok)
+        if buf and _needs_space(buf[-1], s):
+            buf.append(" ")
+        buf.append(s)
+    if buf:
+        pieces.append("".join(buf))
+    out = "\n".join(pieces) + "\n"
+    # self-verification: identical token stream or refuse
+    before = [(t.kind, t.value) for t in tokens]
+    after = [(t.kind, t.value) for t in tokenize(out)[:-1]]
+    if before != after:
+        raise ValueError("minified output does not re-tokenize identically")
+    return out
+
+
+def main(argv=None) -> None:
+    paths = list(sys.argv[1:] if argv is None else argv)
+    if not paths:
+        raise SystemExit("usage: jsminify.py file.js [...]")
+    for path in paths:
+        with open(path, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        out = minify(src)
+        dst = path[: -len(".js")] + ".min.js" if path.endswith(".js") else path + ".min"
+        with open(dst, "w", encoding="utf-8") as fh:
+            fh.write(out)
+        print(f"{path}: {len(src)} -> {len(out)} bytes ({dst})")
+
+
+if __name__ == "__main__":
+    main()

@@ -51,6 +51,18 @@ def select_backend(conf) -> None:
 
     from ..utils import set_cpu_device_count_hint
 
+    if getattr(conf, "dtype", "float32") == "float64":
+        # without this, jnp silently downcasts f64 → f32 and the flag lies.
+        # f64 is the CPU verification dtype (the reference's Java doubles,
+        # LinearRegression.scala:32); TPU hardware has no f64 path, and the
+        # operating-dtype policy (BENCHMARKS.md "Operating dtype") shows
+        # f32 curves match f64 to well under the dashboard's rounding.
+        if conf.backend != "cpu":
+            raise SystemExit(
+                "--dtype float64 runs on the CPU backend only (TPU has no "
+                "f64 hardware path); add --backend cpu"
+            )
+        jax.config.update("jax_enable_x64", True)
     shards = conf.local_shards()
     if shards:
         # honor the local[N] hint before any backend initialization; it only
@@ -99,6 +111,22 @@ def build_source(
             raise SystemExit(
                 "--ingest block is not wired for multi-host runs; "
                 "use --ingest object"
+            )
+    if conf.wire == "ragged":
+        if conf.hashOn != "device":
+            raise SystemExit(
+                "--wire ragged is a device-hash wire format; "
+                "it requires --hashOn device"
+            )
+        if conf.ingest == "block":
+            raise SystemExit(
+                "--wire ragged is not wired for --ingest block; "
+                "use --ingest object or --wire padded"
+            )
+        if multihost:
+            raise SystemExit(
+                "--wire ragged is single-device (a ragged buffer has no "
+                "row sharding); use --wire padded for multi-host runs"
             )
     if conf.ingest == "block" and not allow_block:
         raise SystemExit(
@@ -214,6 +242,12 @@ def build_model(conf, model_cls=StreamingLinearRegressionWithSGD):
     the sharded step). Returns (model, required row multiple for batches)."""
     mesh = build_mesh(conf, what=f"training ({model_cls.__name__})")
     if mesh is not None:
+        if getattr(conf, "wire", "padded") == "ragged":
+            raise SystemExit(
+                "--wire ragged is single-device (a ragged buffer has no row "
+                "sharding); use --wire padded on a mesh, or --master "
+                "local[1]"
+            )
         from ..parallel import ParallelSGDModel
 
         model = ParallelSGDModel.from_conf(
@@ -496,6 +530,11 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None):
             "--superBatch %d ignored: not wired for multi-host runs", k
         )
         k = 1
+    if k > 1 and getattr(stream, "ragged", False):
+        raise SystemExit(
+            "--superBatch is not wired for --wire ragged (ragged buffers "
+            "don't stack); use --wire padded"
+        )
     if k > 1 and (stream.row_bucket <= 0 or stream.token_bucket <= 0):
         raise ValueError(
             "--superBatch needs pinned shapes: set --batchBucket and "
@@ -580,6 +619,20 @@ def warmup_compile(stream, model, super_batch: int = 1) -> None:
 
     from ..features.batch import UnitBatch
 
+    if getattr(stream, "ragged", False):
+        # the ragged wire's units-buffer bucket is DATA-dependent (Σ row
+        # lengths, rounded to RAGGED_UNIT_MULTIPLE) — an all-padding batch
+        # compiles the minimum bucket, not the one real batches will hit,
+        # so full pre-compilation is impossible here. Say so instead of
+        # logging a readiness that isn't real; the first real batch
+        # compiles in-flight (totals concentrate tightly, so steady state
+        # is one or two buckets). Live wall-clock streams that cannot
+        # afford that stall should use --wire padded.
+        log.info(
+            "--wire ragged: units bucket is data-dependent; the first real "
+            "batch compiles its program in-flight (pre-compile n/a)"
+        )
+        return
     t0 = _time.perf_counter()
     empty = stream.featurize_empty()
     variants = [empty]

@@ -63,6 +63,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         row_bucket=conf.batchBucket, token_bucket=conf.tokenBucket,
         row_multiple=row_multiple,
         device_hash=conf.hashOn == "device",
+        ragged=conf.wire == "ragged",
     )
     totals = {"count": 0, "batches": 0}
 

@@ -126,6 +126,11 @@ class ConfArguments:
             raise ValueError(
                 f"ingest must be 'object' or 'block', got {self.ingest!r}"
             )
+        self.wire: str = conf.get("wire", "padded")
+        if self.wire not in ("padded", "ragged"):
+            raise ValueError(
+                f"wire must be 'padded' or 'ragged', got {self.wire!r}"
+            )
         self.l2Reg: float = float(conf.get("l2Reg", "0.0"))
         self.convergenceTol: float = float(conf.get("convergenceTol", "0.001"))
         self.dtype: str = conf.get("dtype", "float32")
@@ -214,6 +219,11 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
   --ingest <object|block>                      Replay ingestion: per-tweet Status objects, or
                                                columnar blocks via the native C parser (~10x
                                                ingest throughput; replay source only). Default: {self.ingest}
+  --wire <padded|ragged>                       Units wire format (hashOn=device): padded [B, L]
+                                               buffer, or ragged concatenated units + offsets
+                                               (no pad bytes on the upload-bound transport;
+                                               single-device, object ingest, no superbatch).
+                                               Default: {self.wire}
   --l2Reg <float>                              L2 regularization. Default: {self.l2Reg}
   --convergenceTol <float>                     SGD convergence tolerance. Default: {self.convergenceTol}
   --dtype <float32|bfloat16|float64>           Device dtype. Default: {self.dtype}
@@ -293,6 +303,10 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
         elif flag == "--ingest":
             self.ingest = take()
             if self.ingest not in ("object", "block"):
+                self.printUsage(1)
+        elif flag == "--wire":
+            self.wire = take()
+            if self.wire not in ("padded", "ragged"):
                 self.printUsage(1)
         elif flag == "--l2Reg":
             self.l2Reg = float(take())

@@ -164,6 +164,64 @@ def _register_packed():
 _register_packed()
 
 
+class RaggedUnitBatch:
+    """A micro-batch whose text ships as CONCATENATED code units + row
+    offsets — no per-row padding on the wire.
+
+    Why: the padded ``UnitBatch`` units buffer is the dominant wire tensor
+    of the streaming hot loop, and every unit beyond a row's length is pure
+    waste on the upload-bound transport (the padded [B, L] carries
+    B·L units where only Σlengths are real — the padding fraction is
+    measured in BENCHMARKS.md). The ragged wire carries Σlengths units
+    (rounded up to ``RAGGED_UNIT_MULTIPLE`` so program count stays finite)
+    plus a [B+1] int32 offsets vector; the learner re-pads INSIDE the jit
+    step with one [B, L] gather (ops-side cost ~nothing; TPU gathers are
+    cheap — it is scatters that serialize) and case-folds ASCII on device,
+    producing bit-identical features (tests/test_ragged_wire.py).
+
+    ``row_len`` (the padded L the device gather rebuilds) is STATIC aux
+    data, like PackedBatch's layout: each distinct (shapes, row_len)
+    compiles once.
+
+    Fields: units [N] uint8|uint16 (narrow iff every row ASCII, as in
+    UnitBatch), offsets [B+1] int32, numeric/label/mask as in UnitBatch.
+    """
+
+    def __init__(self, units, offsets, numeric, label, mask, row_len: int):
+        self.units = units
+        self.offsets = offsets
+        self.numeric = numeric
+        self.label = label
+        self.mask = mask
+        self.row_len = int(row_len)
+
+    @property
+    def num_valid(self) -> int:
+        return int(np.asarray(self.mask).sum())
+
+
+def _register_ragged():
+    import jax
+
+    jax.tree_util.register_pytree_node(
+        RaggedUnitBatch,
+        lambda rb: (
+            (rb.units, rb.offsets, rb.numeric, rb.label, rb.mask),
+            rb.row_len,
+        ),
+        lambda row_len, leaves: RaggedUnitBatch(*leaves, row_len=row_len),
+    )
+
+
+_register_ragged()
+
+# the ragged units buffer rounds its total up to this multiple: waste is
+# bounded by RAGGED_UNIT_MULTIPLE units (≤8 KB uint16) per batch while the
+# program count stays small (total unit counts concentrate tightly around
+# B·mean_len, so real streams hit one or two buckets)
+RAGGED_UNIT_MULTIPLE = 4096
+
+
 def pack_batch(batch: "FeatureBatch | UnitBatch") -> PackedBatch:
     """Flatten a host batch into one uint8 wire buffer (cheap memcpy)."""
     fields = tuple(np.ascontiguousarray(a) for a in batch)

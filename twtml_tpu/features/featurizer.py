@@ -393,6 +393,100 @@ class Featurizer:
         mask[:n] = 1.0
         return numeric, label, mask
 
+    def _encode_batch_texts(self, statuses: list[Status], pre_filtered: bool):
+        """Shared filter + UTF-16 encode for the unit-wire builders
+        (padded ``featurize_batch_units`` and ragged
+        ``featurize_batch_ragged``): returns
+        (keep, originals, units, offsets, lengths, all_ascii)."""
+        from . import native
+
+        keep = (
+            statuses if pre_filtered
+            else [s for s in statuses if self.filtrate(s)]
+        )
+        originals = [s.retweeted_status for s in keep]
+        if self.normalize_accents:
+            texts = [_strip_accents(o.text.lower()) for o in originals]
+            all_ascii = all(t.isascii() for t in texts)
+        else:
+            # case-folding strategy: texts with non-ASCII chars need
+            # Python's Unicode lower(); pure-ASCII texts (the common case)
+            # are folded for free later — during the pad copy (padded wire)
+            # or on device (ragged wire); re-folding the pre-lowered rows'
+            # ASCII range is idempotent
+            all_ascii = True
+            texts = []
+            for o in originals:
+                t = o.text
+                if not t.isascii():
+                    t = t.lower()
+                    all_ascii = False
+                texts.append(t)
+        units, offsets = native.encode_texts(texts)  # pure numpy, C-free
+        lengths = np.diff(offsets).astype(np.int32)
+        return keep, originals, units, offsets, lengths, all_ascii
+
+    @staticmethod
+    def _unit_batch_shape(
+        n: int, lengths, row_bucket: int, unit_bucket: int, row_multiple: int
+    ) -> tuple[int, int]:
+        """The ONE (padded rows, padded row length) policy for both unit
+        wires — padded and ragged MUST agree on compile shapes or the
+        bit-identical-features contract drifts. L ≥ 2 so the device's
+        [:, :-1]/[:, 1:] bigram windows are non-empty."""
+        from .batch import _bucket, pad_row_count
+
+        max_len = int(lengths.max()) if n else 0
+        b = pad_row_count(n, row_bucket, row_multiple)
+        lu = (
+            unit_bucket
+            if unit_bucket >= max(max_len, 2) and unit_bucket > 0
+            else _bucket(max(max_len, 2))
+        )
+        return b, lu
+
+    def featurize_batch_ragged(
+        self,
+        statuses: list[Status],
+        row_bucket: int = 0,
+        unit_bucket: int = 0,
+        pre_filtered: bool = False,
+        row_multiple: int = 1,
+    ):
+        """Filter + encode a micro-batch for the RAGGED device wire
+        (features/batch.RaggedUnitBatch): the units ship concatenated
+        (Σlengths, rounded to RAGGED_UNIT_MULTIPLE) instead of padded
+        (B·L_bucket) — the learner re-pads with one gather and case-folds
+        ASCII inside the jit step, producing features bit-identical to the
+        padded paths (differential tests in tests/test_ragged_wire.py).
+        ``unit_bucket`` still pins the REBUILT row length L (compile-shape
+        discipline); only the wire stops paying for padding."""
+        from .batch import RAGGED_UNIT_MULTIPLE, RaggedUnitBatch
+
+        keep, originals, units, offsets, lengths, all_ascii = (
+            self._encode_batch_texts(statuses, pre_filtered)
+        )
+        n = len(keep)
+        b, lu = self._unit_batch_shape(
+            n, lengths, row_bucket, unit_bucket, row_multiple
+        )
+        total = int(offsets[-1]) if n else 0
+        n_bucket = max(
+            RAGGED_UNIT_MULTIPLE,
+            -(-total // RAGGED_UNIT_MULTIPLE) * RAGGED_UNIT_MULTIPLE,
+        )
+        # narrow uint8 wire iff every row is ASCII — same metadata gate as
+        # the padded wire (_pad_ragged_units); the downcast is lossless then
+        flat = np.zeros((n_bucket,), np.uint8 if all_ascii else np.uint16)
+        flat[:total] = units[:total]
+        offs = np.full((b + 1,), total, np.int32)
+        offs[: n + 1] = offsets[: n + 1].astype(np.int32)
+        enc = (units, offsets) if not self.normalize_accents else None
+        numeric, label, mask = self._numeric_label_mask(
+            keep, originals, b, encoded=enc
+        )
+        return RaggedUnitBatch(flat, offs, numeric, label, mask, row_len=lu)
+
     def featurize_batch_units(
         self,
         statuses: list[Status],
@@ -409,38 +503,12 @@ class Featurizer:
         features bit-identical to `featurize_batch`'s. Host cost per batch
         drops to one encode + one vectorized pad — no per-bigram work at all.
         """
-        from . import native
-        from .batch import _bucket, pad_row_count
-
-        keep = statuses if pre_filtered else [s for s in statuses if self.filtrate(s)]
-
+        keep, originals, units, offsets, lengths, all_ascii = (
+            self._encode_batch_texts(statuses, pre_filtered)
+        )
         n = len(keep)
-        originals = [s.retweeted_status for s in keep]
-        if self.normalize_accents:
-            texts = [_strip_accents(o.text.lower()) for o in originals]
-            all_ascii = all(t.isascii() for t in texts)
-        else:
-            # case-folding strategy: texts with non-ASCII chars need
-            # Python's Unicode lower(); pure-ASCII texts (the common case)
-            # are folded for free during the pad copy ('A'-'Z'+32, and
-            # re-folding the pre-lowered rows' ASCII range is idempotent)
-            all_ascii = True
-            texts = []
-            for o in originals:
-                t = o.text
-                if not t.isascii():
-                    t = t.lower()
-                    all_ascii = False
-                texts.append(t)
-        units, offsets = native.encode_texts(texts)  # pure numpy, C-free
-        lengths = np.diff(offsets).astype(np.int32)
-        max_len = int(lengths.max()) if n else 0
-        b = pad_row_count(n, row_bucket, row_multiple)
-        # L ≥ 2 so the device's [:, :-1]/[:, 1:] bigram windows are non-empty
-        lu = (
-            unit_bucket
-            if unit_bucket >= max(max_len, 2) and unit_bucket > 0
-            else _bucket(max(max_len, 2))
+        b, lu = self._unit_batch_shape(
+            n, lengths, row_bucket, unit_bucket, row_multiple
         )
         buf, length = _pad_ragged_units(
             units, offsets, lengths, n, b, lu, narrow=all_ascii

@@ -37,6 +37,7 @@ from ..features.batch import (
     NUM_NUMBER_FEATURES,
     FeatureBatch,
     PackedBatch,
+    RaggedUnitBatch,
     UnitBatch,
     unpack_batch,
 )
@@ -360,6 +361,25 @@ def make_sgd_train_step(
             # one-buffer wire format: reinterpret in-place (features/batch.py
             # PackedBatch — bit-identical arrays, transfer-count 5 → 1)
             batch = unpack_batch(batch.buffer, batch.layout)
+        if isinstance(batch, RaggedUnitBatch):
+            # ragged wire: the units arrive concatenated (no per-row pad
+            # bytes on the transport); rebuild the padded [B, L] with ONE
+            # gather (cheap on TPU — scatters serialize, gathers don't) and
+            # case-fold ASCII here, which the padded wire's C pad copy did
+            # on the host — bit-identical units either way
+            offs = batch.offsets.astype(jnp.int32)
+            starts, lens = offs[:-1], offs[1:] - offs[:-1]
+            cols = jnp.arange(batch.row_len, dtype=jnp.int32)[None, :]
+            idx = jnp.clip(
+                starts[:, None] + cols, 0, batch.units.shape[0] - 1
+            )
+            buf = jnp.where(
+                cols < lens[:, None], batch.units[idx].astype(jnp.int32), 0
+            )
+            buf = buf + ((buf >= 65) & (buf <= 90)) * 32  # ASCII fold
+            batch = UnitBatch(
+                buf, lens, batch.numeric, batch.label, batch.mask
+            )
         if isinstance(batch, UnitBatch):
             # on-device featurization: hash the raw code units inside this
             # same XLA program (ops/text_hash.py); per-occurrence 1.0 values

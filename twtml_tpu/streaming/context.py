@@ -88,6 +88,7 @@ class FeatureStream(RawStream):
         token_bucket: int = 0,
         row_multiple: int = 1,
         device_hash: bool = False,
+        ragged: bool = False,
     ):
         super().__init__()
         self.featurizer = featurizer
@@ -95,6 +96,12 @@ class FeatureStream(RawStream):
         self.token_bucket = token_bucket
         self.row_multiple = row_multiple
         self.device_hash = device_hash
+        self.ragged = ragged
+        if ragged and not device_hash:
+            raise ValueError(
+                "the ragged wire IS a device-hash wire format: "
+                "--wire ragged requires --hashOn device"
+            )
         self._bucket_overflow_warned = False
         # the pinned row shape includes the mesh-divisibility round-up,
         # matching every batch the featurizer emits; fixed at construction
@@ -108,6 +115,12 @@ class FeatureStream(RawStream):
     def batch_shape(batch) -> "tuple[int, int]":
         """(rows, tokens-or-units) of a featurized batch — the two axes the
         pinned buckets govern."""
+        from ..features.batch import RaggedUnitBatch
+
+        if isinstance(batch, RaggedUnitBatch):
+            # the ragged wire's row length is static aux (the device-side
+            # re-pad width) — the same axis token_bucket pins
+            return batch.mask.shape[0], batch.row_len
         tokens = (
             batch.units.shape[1]
             if isinstance(batch, UnitBatch)
@@ -154,6 +167,15 @@ class FeatureStream(RawStream):
                 unit_bucket=self.token_bucket, row_multiple=self.row_multiple,
             )
         if self.device_hash:
+            if self.ragged:
+                # concatenated units + offsets: no per-row pad bytes on the
+                # upload-bound wire (features/batch.RaggedUnitBatch —
+                # measured +14% paired vs the padded wire, BENCHMARKS.md)
+                return self.featurizer.featurize_batch_ragged(
+                    statuses, row_bucket=self.row_bucket,
+                    unit_bucket=self.token_bucket,
+                    row_multiple=self.row_multiple,
+                )
             # ship raw code units; the learner hashes bigrams on device
             # (ops/text_hash.py) — bit-identical features, ~2x host headroom
             return self.featurizer.featurize_batch_units(
@@ -204,6 +226,7 @@ class StreamingContext:
         token_bucket: int = 0,
         row_multiple: int = 1,
         device_hash: bool = False,
+        ragged: bool = False,
     ) -> FeatureStream:
         """Attach the (single) source and build its feature stream —
         equivalent of TwitterUtils.createStream().filter().map().cache()
@@ -212,7 +235,8 @@ class StreamingContext:
             raise ValueError("StreamingContext supports one source stream")
         self._source = source
         self._stream = FeatureStream(
-            featurizer, row_bucket, token_bucket, row_multiple, device_hash
+            featurizer, row_bucket, token_bucket, row_multiple, device_hash,
+            ragged,
         )
         return self._stream
 

@@ -121,6 +121,30 @@ class Server:
         target = self._assets
         for p in parts:
             target = target.joinpath(p)
+        if rel.endswith(".js") and not rel.endswith(".min.js"):
+            # dist builds ship minified assets (tools/jsminify.py via
+            # scripts/build_dist.sh — the reference's sbt-uglify analog,
+            # web/build.sbt:25-39): serve file.min.js when present, so the
+            # dashboard loads the minified bundle without URL changes.
+            # Staleness guard for dev trees: a leftover (gitignored)
+            # .min.js older than an edited source must not shadow the fix;
+            # when mtimes are unavailable (zip deploys — immutable), the
+            # minified file wins.
+            minified = self._assets
+            for p in parts[:-1]:
+                minified = minified.joinpath(p)
+            minified = minified.joinpath(parts[-1][:-3] + ".min.js")
+            if minified.is_file():
+                try:
+                    import os as _os
+
+                    fresh = _os.path.getmtime(str(minified)) >= (
+                        _os.path.getmtime(str(target))
+                    )
+                except OSError:
+                    fresh = True
+                if fresh:
+                    target = minified
         if not target.is_file():
             raise web.HTTPNotFound
         ctype, _ = mimetypes.guess_type(rel)
