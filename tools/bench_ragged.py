@@ -6,9 +6,10 @@ reports, for a corpus at a given batch size:
 
   - the padding fraction of the padded units buffer (1 - Σlen / B·L);
   - wire bytes per batch for both formats (all five arrays);
-  - the pipelined end-to-end rate (utils/benchloop.measure_pipeline —
-    dispatch freely, one completion fetch per pass, best-of under a time
-    budget) for both formats, on the current backend.
+  - the pipelined end-to-end rate for both formats on the current
+    backend — single passes INTERLEAVED A/B/A/B (utils/benchloop._run_once
+    per pass: dispatch freely, one completion fetch), with paired
+    per-round ratios so tunnel phase swings hit both arms equally.
 
 Usage: python tools/bench_ragged.py [--tweets N] [--batch B] [--budget S]
        [--config dense|2e18]
@@ -55,7 +56,6 @@ def main(argv=None) -> None:
     from twtml_tpu.features.featurizer import Featurizer
     from twtml_tpu.models import StreamingLinearRegressionWithSGD
     from twtml_tpu.streaming.sources import SyntheticSource
-    from twtml_tpu.utils.benchloop import measure_pipeline
 
     f_text = 2**18 if config == "2e18" else 1000
     feat = Featurizer(num_text_features=f_text, now_ms=1785320000000)

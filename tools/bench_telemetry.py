@@ -1,13 +1,16 @@
-"""Per-batch-telemetry regime: synchronous stats fetch vs the one-batch-lag
-pipeline (apps/common.LagPipeline — VERDICT r2 #2).
+"""Per-batch-telemetry regime: three fetch strategies, interleaved.
 
 The production apps read the full StepOutput every batch for the stats
 plane; through this build's tunnel each host fetch is a ~70-100 ms round
 trip, capping the back-to-back telemetry-on rate far below the free-
-dispatch rate. The lag pipeline dispatches batch k, then fetches k-1
-(whose device→host copy started at its dispatch), so the round trip
-overlaps the next batch's work. Arms interleave within one window; paired
-per-round ratios are the phase-robust comparison.
+dispatch rate. Arms (single passes round-robin in one window; paired
+per-round ratios are the phase-robust comparison):
+
+- sync   : device_get right after each dispatch (the r2 baseline);
+- lag    : one-batch-lag fetch (VERDICT r2 #2's proposal) — measured
+           NEUTRAL here, kept for the record;
+- pool8  : concurrent in-order fetches on a thread pool — the measured
+           6.2x winner, shipped as apps/common.FetchPipeline.
 
 Usage: python tools/bench_telemetry.py [--tweets N] [--batch B] [--budget S]
 Prints one JSON line.
@@ -41,7 +44,6 @@ def main(argv=None) -> None:
 
     import jax
 
-    from twtml_tpu.apps.common import LagPipeline
     from twtml_tpu.features.featurizer import Featurizer
     from twtml_tpu.models import StreamingLinearRegressionWithSGD
     from twtml_tpu.streaming.sources import SyntheticSource
@@ -72,19 +74,50 @@ def main(argv=None) -> None:
         return time.perf_counter() - t0
 
     def lag_pass():
+        """One-batch-lag fetch (dispatch k, then fetch k-1; async copy at
+        dispatch) — kept as an arm for the record: measured NEUTRAL on this
+        transport (device_get is an RTT-bound request), which is why the
+        shipped pipeline is the concurrent pool below instead."""
         model.reset()
-        pipe = LagPipeline(model, consume)
+        pending = None
         t0 = time.perf_counter()
         for b in batches:
-            pipe.on_batch(b, 0.0)
-        pipe.flush()
+            out = model.step(b)
+            for leaf in jax.tree_util.tree_leaves(out):
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
+            if pending is not None:
+                consume(jax.device_get(pending[0]), pending[1], 0.0)
+            pending = (out, b)
+        if pending is not None:
+            consume(jax.device_get(pending[0]), pending[1], 0.0)
         return time.perf_counter() - t0
 
-    times = {"sync": [], "lag": []}
+    from concurrent.futures import ThreadPoolExecutor
+
+    def pool_pass(workers=8):
+        """Fetch each batch's StepOutput on a thread pool while the main
+        thread keeps dispatching; consume in order. If the transport
+        accepts concurrent host-fetch requests, N in-flight requests
+        pipeline the RTT (throughput → N/RTT); if it serializes them,
+        this matches sync. (device_put off-main collapses throughput —
+        measured r2 — but these are GETs.)"""
+        model.reset()
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            futs = [
+                pool.submit(jax.device_get, model.step(b)) for b in batches
+            ]
+            for f, b in zip(futs, batches):
+                consume(f.result(), b, 0.0)
+        return time.perf_counter() - t0
+
+    times = {"sync": [], "lag": [], "pool8": []}
     t_end = time.perf_counter() + budget
     while time.perf_counter() < t_end:
         times["sync"].append(sync_pass())
         times["lag"].append(lag_pass())
+        times["pool8"].append(pool_pass())
 
     out = {"regime": "per-batch-telemetry", "batch": batch,
            "tweets": n_tweets, "backend": jax.default_backend(),
@@ -94,10 +127,13 @@ def main(argv=None) -> None:
             "tweets_per_sec_best": round(n_tweets / min(ts), 1),
             "tweets_per_sec_median": round(n_tweets / statistics.median(ts), 1),
         }
-    out["paired_speedup_median"] = round(
-        statistics.median([s / l for s, l in zip(times["sync"], times["lag"])]),
-        3,
-    )
+    for name in ("lag", "pool8"):
+        out[name]["paired_speedup_vs_sync"] = round(
+            statistics.median(
+                [s / t for s, t in zip(times["sync"], times[name])]
+            ),
+            3,
+        )
     print(json.dumps(out))
 
 

@@ -118,11 +118,6 @@ def build_source(
                 "--wire ragged is a device-hash wire format; "
                 "it requires --hashOn device"
             )
-        if conf.ingest == "block":
-            raise SystemExit(
-                "--wire ragged is not wired for --ingest block; "
-                "use --ingest object or --wire padded"
-            )
         if multihost:
             raise SystemExit(
                 "--wire ragged is single-device (a ragged buffer has no "
@@ -433,63 +428,91 @@ class SuperBatcher:
             )
 
 
-class LagPipeline:
-    """One-batch-lag telemetry fetch for back-to-back regimes: handle batch
-    k−1's StepOutput (already fetched or in flight, ``copy_to_host_async``
-    at dispatch time) just before dispatching batch k.
+class FetchPipeline:
+    """Depth-D concurrent stats fetch for back-to-back regimes: the main
+    thread dispatches ``model.step(batch)`` and hands each StepOutput's
+    host fetch to a small thread pool; completed outputs are consumed IN
+    ORDER on the main thread.
 
     Why: the per-batch stats fetch through this build's TPU tunnel is a
-    ~70–100 ms round trip (BENCHMARKS.md telemetry regime). A synchronous
-    ``device_get`` right after its own dispatch pays the full trip idle;
-    lagging the fetch one batch starts the device→host copy at dispatch
-    time, so the trip overlaps the next batch's featurize + upload and the
-    blocked portion shrinks to what the pipeline couldn't hide.
+    ~70–100 ms RTT-bound REQUEST — a one-batch-lagged fetch measured
+    NEUTRAL (0.996×; starting the copy early doesn't shorten the request),
+    but CONCURRENT ``device_get``s pipeline the transport: measured
+    **6.2× paired** at depth 8, batch 2048 (17k → 108k median tweets/s,
+    tools/bench_telemetry.py; BENCHMARKS.md). Dispatch and ``device_put``
+    stay on the main thread — the measured r2 throughput collapse is
+    put-specific; gets from worker threads are exactly what the 6.2×
+    measurement exercised.
 
-    Semantics are EXACTLY the synchronous path's: same step, same
-    ``device_get``, per-batch stats; at emit time the lagged batch's step is
-    the newest dispatch, so ``model.latest_weights`` are current as of that
-    batch (``at_boundary=True`` — checkpoints stay correct), and a stop
-    requested by the handler (max-batches caps) vetoes the NEXT dispatch, so
-    exactly as many batches train as with inline fetches. ``flush()`` after
-    stream termination drains the final pending batch."""
+    Semantics vs the synchronous path: per-batch stats identical and in
+    order; ``at_boundary`` is True only when nothing newer has been
+    dispatched (pipeline drained — end of stream, or a ``boundary_every``
+    cadence drain so checkpoint saves still see current weights, exactly
+    like the superbatch's group boundaries); ``max_dispatch`` caps how
+    many batches may train, so max-batches stops stay EXACT (the cap is
+    enforced before dispatch, not discovered after). ``flush()`` after
+    stream termination drains the tail."""
 
-    def __init__(self, model, handle, stop_requested=None):
+    def __init__(self, model, handle, depth: int = 8, stop_requested=None,
+                 boundary_every: int = 0, max_dispatch: int = 0):
+        from concurrent.futures import ThreadPoolExecutor
+
         self.model = model
         self.handle = handle
+        self.depth = max(1, depth)
         self._stop_requested = stop_requested
-        self._pending = None
+        self.boundary_every = boundary_every
+        self.max_dispatch = max_dispatch
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.depth, thread_name_prefix="twtml-stats-fetch"
+        )
+        self._pending: list = []  # [(future, batch, t)] oldest first
+        self._dispatched = 0
 
-    def _emit(self) -> None:
-        import jax
+    def _emit_one(self) -> None:
+        future, batch, t = self._pending.pop(0)
+        self.handle(
+            future.result(), batch, t, at_boundary=not self._pending
+        )
 
-        out, batch, t = self._pending
-        self._pending = None
-        self.handle(jax.device_get(out), batch, t, at_boundary=True)
+    def _drain(self) -> None:
+        while self._pending:
+            self._emit_one()
 
     def on_batch(self, batch, t) -> None:
         import jax
 
         stop = self._stop_requested
         if stop is not None and stop():
-            return  # stop already requested: nothing more may train
-        if self._pending is not None:
-            self._emit()
+            return  # stop requested: nothing more may train
+        if self.max_dispatch and self._dispatched >= self.max_dispatch:
+            # cap reached: later batches must not train — but whatever DID
+            # train must still be delivered NOW, or the handler-side stop
+            # (max-batches → request_stop) never fires and an unbounded
+            # live source keeps batching forever
+            self._drain()
+            return
+        # backpressure + timeliness: block down to depth-1 in flight, then
+        # opportunistically consume whatever already finished
+        while len(self._pending) >= self.depth or (
+            self._pending and self._pending[0][0].done()
+        ):
+            self._emit_one()
             if stop is not None and stop():
-                # the cap landed on the lagged batch: dispatching this one
-                # would train past it — drop it, as the inline path does
-                return
-        out = self.model.step(batch)
-        for leaf in jax.tree_util.tree_leaves(out):
-            if hasattr(leaf, "copy_to_host_async"):
-                leaf.copy_to_host_async()
-        self._pending = (out, batch, t)
+                return  # the cap landed on an emitted batch: do not dispatch
+        out = self.model.step(batch)  # dispatch on the MAIN thread
+        self._pending.append((self._pool.submit(jax.device_get, out), batch, t))
+        self._dispatched += 1
+        if self.boundary_every and self._dispatched % self.boundary_every == 0:
+            self._drain()  # cadence point: weights current for checkpoints
 
     def flush(self) -> None:
-        if self._pending is not None:
-            self._emit()
+        self._drain()
+        self._pool.shutdown(wait=False)
 
 
-def attach_super_batcher(conf, stream, model, handle, stop_requested=None):
+def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
+                         max_dispatch: int = 0):
     """Wire the app's per-batch ``handle(out, batch, t, at_boundary)`` to the
     stream: plain step-then-handle by default, grouped through a
     SuperBatcher when ``--superBatch K`` applies. Returns
@@ -502,8 +525,10 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None):
     side effects that read ``model.latest_weights``, e.g. checkpoints.
 
     ``stop_requested``: optional predicate (the app's
-    ``ssc.stop_requested``) that lets the lagged-fetch pipeline honor a
-    max-batches stop exactly (see LagPipeline).
+    ``ssc.stop_requested``) that lets the fetch pipeline honor a
+    max-batches stop; ``max_dispatch`` additionally caps how many batches
+    may ever train (exact max-batches under the concurrent fetch pipeline
+    — see FetchPipeline).
 
     Group-granular caps: a whole group dispatches as one program, so a
     ``max_batches``-style stop lands on the first group boundary at/after
@@ -576,10 +601,22 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None):
 
     if k <= 1:
         if conf.seconds <= 0:
-            # back-to-back: lag the stats fetch one batch so the transport
-            # round trip overlaps the next batch's work (exact per-batch
-            # semantics — see LagPipeline)
-            pipe = LagPipeline(model, handle, stop_requested)
+            # back-to-back: concurrent in-order stats fetches pipeline the
+            # transport round trip (measured 6.2x paired at depth 8 —
+            # FetchPipeline); checkpoint cadence points drain the pipeline
+            # so saves see current weights
+            pipe = FetchPipeline(
+                model, handle, stop_requested=stop_requested,
+                # cadence drains exist for checkpoint saves only: without a
+                # checkpointDir each drain would stall the pipeline (and
+                # the 6.2x win) for a no-op save
+                boundary_every=(
+                    int(getattr(conf, "checkpointEvery", 0) or 0)
+                    if getattr(conf, "checkpointDir", "")
+                    else 0
+                ),
+                max_dispatch=max_dispatch,
+            )
             stream.foreach_batch(skip_empty(pipe.on_batch))
             return pipe.flush, 1
 

@@ -109,7 +109,11 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
             ssc.request_stop()
 
     flush_group, group_k = attach_super_batcher(
-        conf, stream, model, handle, stop_requested=lambda: ssc.stop_requested
+        conf, stream, model, handle,
+        stop_requested=lambda: ssc.stop_requested,
+        max_dispatch=(
+            max(1, max_batches - totals["batches"]) if max_batches else 0
+        ),
     )
     warmup_compile(stream, model, super_batch=group_k)
     ssc.start(lockstep=lockstep)

@@ -222,6 +222,26 @@ _register_ragged()
 RAGGED_UNIT_MULTIPLE = 4096
 
 
+def ragged_wire_arrays(
+    units: np.ndarray, offsets: np.ndarray, n: int, b: int, narrow: bool
+) -> tuple[np.ndarray, np.ndarray]:
+    """(flat units buffer, padded [b+1] int32 offsets) for the ragged wire —
+    the ONE bucket/narrowing policy shared by both featurizer builders
+    (Status lists and columnar blocks), so the formats cannot drift.
+    ``narrow`` ships uint8 (lossless iff every row is ASCII — the callers'
+    metadata gate); pad rows get ``offsets[i] = total`` (length 0)."""
+    total = int(offsets[-1]) if n else 0
+    n_bucket = max(
+        RAGGED_UNIT_MULTIPLE,
+        -(-total // RAGGED_UNIT_MULTIPLE) * RAGGED_UNIT_MULTIPLE,
+    )
+    flat = np.zeros((n_bucket,), np.uint8 if narrow else np.uint16)
+    flat[:total] = units[:total]
+    offs = np.full((b + 1,), total, np.int32)
+    offs[: n + 1] = offsets[: n + 1].astype(np.int32)
+    return flat, offs
+
+
 def pack_batch(batch: "FeatureBatch | UnitBatch") -> PackedBatch:
     """Flatten a host batch into one uint8 wire buffer (cheap memcpy)."""
     fields = tuple(np.ascontiguousarray(a) for a in batch)

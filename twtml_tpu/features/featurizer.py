@@ -461,7 +461,7 @@ class Featurizer:
         padded paths (differential tests in tests/test_ragged_wire.py).
         ``unit_bucket`` still pins the REBUILT row length L (compile-shape
         discipline); only the wire stops paying for padding."""
-        from .batch import RAGGED_UNIT_MULTIPLE, RaggedUnitBatch
+        from .batch import RaggedUnitBatch, ragged_wire_arrays
 
         keep, originals, units, offsets, lengths, all_ascii = (
             self._encode_batch_texts(statuses, pre_filtered)
@@ -470,17 +470,9 @@ class Featurizer:
         b, lu = self._unit_batch_shape(
             n, lengths, row_bucket, unit_bucket, row_multiple
         )
-        total = int(offsets[-1]) if n else 0
-        n_bucket = max(
-            RAGGED_UNIT_MULTIPLE,
-            -(-total // RAGGED_UNIT_MULTIPLE) * RAGGED_UNIT_MULTIPLE,
-        )
         # narrow uint8 wire iff every row is ASCII — same metadata gate as
         # the padded wire (_pad_ragged_units); the downcast is lossless then
-        flat = np.zeros((n_bucket,), np.uint8 if all_ascii else np.uint16)
-        flat[:total] = units[:total]
-        offs = np.full((b + 1,), total, np.int32)
-        offs[: n + 1] = offsets[: n + 1].astype(np.int32)
+        flat, offs = ragged_wire_arrays(units, offsets, n, b, narrow=all_ascii)
         enc = (units, offsets) if not self.normalize_accents else None
         numeric, label, mask = self._numeric_label_mask(
             keep, originals, b, encoded=enc
@@ -527,7 +519,8 @@ class Featurizer:
         row_bucket: int = 0,
         unit_bucket: int = 0,
         row_multiple: int = 1,
-    ) -> UnitBatch:
+        ragged: bool = False,
+    ):
         """Columnar block (features/blocks.py, rows already filtered by the
         native parser) → UnitBatch, with zero per-tweet Python work in the
         common case: numeric scaling is vectorized and text goes straight to
@@ -538,7 +531,6 @@ class Featurizer:
         scorer); the Status-based ``label_fn``/``batch_label_fn`` need the
         object ingest path and are rejected here."""
         from . import native
-        from .batch import _bucket, pad_row_count
         from .blocks import (
             COL_CREATED_MS,
             COL_FAVOURITES,
@@ -596,19 +588,13 @@ class Featurizer:
             else:
                 units = new_units
         lengths = np.diff(offsets).astype(np.int32)
-        max_len = int(lengths.max()) if n else 0
-        b = pad_row_count(n, row_bucket, row_multiple)
-        lu = (
-            unit_bucket
-            if unit_bucket >= max(max_len, 2) and unit_bucket > 0
-            else _bucket(max(max_len, 2))
+        b, lu = self._unit_batch_shape(
+            n, lengths, row_bucket, unit_bucket, row_multiple
         )
         # narrow wire iff every row is parser-ASCII-flagged: redo rows are
         # exactly the non-ASCII ones (normalize_accents marks all rows redo,
         # so it conservatively keeps the wide wire) — metadata, never sniffed
-        buf, length = _pad_ragged_units(
-            units, offsets, lengths, n, b, lu, narrow=n == 0 or redo.size == 0
-        )
+        narrow = n == 0 or redo.size == 0
 
         now = self.now_ms if self.now_ms is not None else int(time.time() * 1000)
         numeric = np.zeros((b, NUM_NUMBER_FEATURES), dtype=np.float32)
@@ -629,4 +615,16 @@ class Featurizer:
             else:
                 label[:n] = cols64[:, COL_LABEL]
             mask[:n] = 1.0
+        if ragged:
+            # the block ALREADY holds concatenated units + offsets — the
+            # ragged wire ships them as-is (no pad copy at all); the jit
+            # step re-pads with one gather + device ASCII fold, features
+            # bit-identical to the padded path (tests/test_ragged_wire.py)
+            from .batch import RaggedUnitBatch, ragged_wire_arrays
+
+            flat, offs = ragged_wire_arrays(units, offsets, n, b, narrow=narrow)
+            return RaggedUnitBatch(flat, offs, numeric, label, mask, row_len=lu)
+        buf, length = _pad_ragged_units(
+            units, offsets, lengths, n, b, lu, narrow=narrow
+        )
         return UnitBatch(buf, length, numeric, label, mask)

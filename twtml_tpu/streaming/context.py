@@ -165,6 +165,7 @@ class FeatureStream(RawStream):
             return self.featurizer.featurize_parsed_block(
                 merge_blocks(statuses), row_bucket=self.row_bucket,
                 unit_bucket=self.token_bucket, row_multiple=self.row_multiple,
+                ragged=self.ragged,
             )
         if self.device_hash:
             if self.ragged:

@@ -118,9 +118,10 @@ class Server:
             for p in parts
         ):
             raise web.HTTPNotFound
-        target = self._assets
-        for p in parts:
-            target = target.joinpath(p)
+        parent = self._assets
+        for p in parts[:-1]:
+            parent = parent.joinpath(p)
+        target = parent.joinpath(parts[-1])
         if rel.endswith(".js") and not rel.endswith(".min.js"):
             # dist builds ship minified assets (tools/jsminify.py via
             # scripts/build_dist.sh — the reference's sbt-uglify analog,
@@ -130,10 +131,7 @@ class Server:
             # .min.js older than an edited source must not shadow the fix;
             # when mtimes are unavailable (zip deploys — immutable), the
             # minified file wins.
-            minified = self._assets
-            for p in parts[:-1]:
-                minified = minified.joinpath(p)
-            minified = minified.joinpath(parts[-1][:-3] + ".min.js")
+            minified = parent.joinpath(parts[-1][:-3] + ".min.js")
             if minified.is_file():
                 try:
                     import os as _os
