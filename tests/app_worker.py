@@ -38,8 +38,14 @@ if nprocs > 1:
         "--processId", str(pid),
     ]
 
-from twtml_tpu.apps import linear_regression, logistic_regression  # noqa: E402
-
-{"linear": linear_regression, "logistic": logistic_regression}[app_name].main(
-    app_args
+from twtml_tpu.apps import (  # noqa: E402
+    kmeans,
+    linear_regression,
+    logistic_regression,
 )
+
+{
+    "linear": linear_regression,
+    "logistic": logistic_regression,
+    "kmeans": kmeans,
+}[app_name].main(app_args)

@@ -211,6 +211,53 @@ def test_app_level_multihost_cli_trains_in_lockstep(tmp_path):
     assert meta_m2["count"] == 400
 
 
+def test_app_level_multihost_kmeans_lockstep(tmp_path):
+    """The k-means entry through the multi-host CLI: per-host sharded
+    intake, GLOBAL per-batch StandardScaler, mesh psums spanning hosts —
+    lead-printed centers/counts match a single-process run of the same app
+    over the same replay file (same global batch rows, interleaved
+    order)."""
+    import json as _json
+    import re
+
+    from tools.bench_suite import _status_json
+    from twtml_tpu.streaming.sources import SyntheticSource
+
+    path = tmp_path / "tweets.jsonl"
+    statuses = list(
+        SyntheticSource(total=96, seed=6, base_ms=1785320000000).produce()
+    )
+    with open(path, "w") as fh:
+        for s in statuses:
+            fh.write(_json.dumps(_status_json(s)) + "\n")
+
+    closed = "http://127.0.0.1:9"
+    common = [
+        "kmeans", "--source", "replay", "--replayFile", str(path),
+        "--seconds", "0", "--backend", "cpu",
+        "--lightning", closed, "--twtweb", closed,
+    ]
+    single = _run_app_group(common + ["--batchBucket", "32"], nprocs=1, ndev=4)
+    multi = _run_app_group(common + ["--batchBucket", "16"], nprocs=2, ndev=2)
+
+    def stat_lines(out):
+        return [ln for ln in out.splitlines() if ln.startswith("count:")]
+
+    lead, follower = stat_lines(multi[0]), stat_lines(multi[1])
+    ref = stat_lines(single[0])
+    assert follower == []
+    assert len(lead) == len(ref) >= 2
+    for got, want in zip(lead, ref):
+        g = [float(x) for x in re.findall(r"-?\d+\.?\d*", got)]
+        w = [float(x) for x in re.findall(r"-?\d+\.?\d*", want)]
+        assert g[:2] == w[:2]  # cumulative count and batch size: exact
+        # centers (rounded to 3 decimals) agree within FP-order noise of
+        # the interleaved global row order
+        assert len(g) == len(w)
+        for a, b in zip(g[2:], w[2:]):
+            assert abs(a - b) <= max(0.02, 0.02 * abs(b)), (got, want)
+
+
 def test_two_process_2d_mesh_checkpoint_roundtrip(tmp_path):
     """Checkpoint round-trip where weight shards span PROCESS boundaries:
     latest_weights process_allgathers, pid 0 writes, both restore into fresh

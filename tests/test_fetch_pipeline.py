@@ -6,15 +6,12 @@ stats in order, at_boundary only with current weights (drains), exact
 max-batches caps, tail drained by flush()."""
 
 import json
-import os
 
 import numpy as np
 
 from twtml_tpu.apps.common import FetchPipeline
 from twtml_tpu.config import ConfArguments
 from twtml_tpu.streaming.sources import SyntheticSource
-
-DATA = os.path.join(os.path.dirname(__file__), "data", "tweets.jsonl")
 
 
 class FakeModel:

@@ -12,8 +12,10 @@ reports, for a corpus at a given batch size:
     per-round ratios so tunnel phase swings hit both arms equally.
 
 Usage: python tools/bench_ragged.py [--tweets N] [--batch B] [--budget S]
-       [--config dense|2e18]
-Prints one JSON line.
+       [--config dense|2e18] [--ingest object|block]
+Prints one JSON line. ``--ingest block`` compares the formats fed from the
+native columnar parser's blocks (featurize_parsed_block) instead of Status
+objects — the ragged form there skips the pad copy entirely.
 """
 
 from __future__ import annotations
@@ -37,6 +39,7 @@ def wire_bytes(batch) -> int:
 def main(argv=None) -> None:
     args = list(sys.argv[1:] if argv is None else argv)
     n_tweets, batch_size, budget, config = 65536, 2048, 45.0, "dense"
+    ingest = "object"
     i = 0
     while i < len(args):
         if args[i] == "--tweets":
@@ -47,6 +50,8 @@ def main(argv=None) -> None:
             budget = float(args[i + 1]); i += 2
         elif args[i] == "--config":
             config = args[i + 1]; i += 2
+        elif args[i] == "--ingest":
+            ingest = args[i + 1]; i += 2
         else:
             raise SystemExit(f"unknown flag {args[i]!r}")
 
@@ -60,20 +65,58 @@ def main(argv=None) -> None:
     f_text = 2**18 if config == "2e18" else 1000
     feat = Featurizer(num_text_features=f_text, now_ms=1785320000000)
     statuses = list(SyntheticSource(total=n_tweets, seed=3).produce())
-    chunks = [
-        statuses[i : i + batch_size]
-        for i in range(0, len(statuses), batch_size)
-    ]
+
+    if ingest == "block":
+        # columnar-block chunks (the config #1 path): materialize the
+        # stream to .jsonl once, parse with the native loader, slice into
+        # fixed row chunks; featurize_parsed_block builds either wire
+        import tempfile
+
+        from tools.bench_suite import _status_json
+        from twtml_tpu.features.blocks import iter_row_chunks, merge_blocks
+        from twtml_tpu.streaming.sources import BlockReplayFileSource
+
+        with tempfile.NamedTemporaryFile(
+            "w", suffix=".jsonl", delete=False
+        ) as fh:
+            for s in statuses:
+                fh.write(json.dumps(_status_json(s)) + "\n")
+            path = fh.name
+        block = merge_blocks(list(BlockReplayFileSource(path).produce()))
+        os.unlink(path)
+        chunks = list(iter_row_chunks([block], batch_size))
+
+        def fz_padded(sub):
+            return feat.featurize_parsed_block(sub, row_bucket=batch_size)
+
+        def fz_ragged(sub):
+            return feat.featurize_parsed_block(
+                sub, row_bucket=batch_size, ragged=True
+            )
+    else:
+        chunks = [
+            statuses[i : i + batch_size]
+            for i in range(0, len(statuses), batch_size)
+        ]
+
+        def fz_padded(c):
+            return feat.featurize_batch_units(
+                c, row_bucket=batch_size, pre_filtered=True
+            )
+
+        def fz_ragged(c):
+            return feat.featurize_batch_ragged(
+                c, row_bucket=batch_size, pre_filtered=True
+            )
 
     # ---- wire accounting on the first full chunk -------------------------
-    pb = feat.featurize_batch_units(chunks[0], row_bucket=batch_size,
-                                    pre_filtered=True)
-    rb = feat.featurize_batch_ragged(chunks[0], row_bucket=batch_size,
-                                     pre_filtered=True)
+    pb = fz_padded(chunks[0])
+    rb = fz_ragged(chunks[0])
     real_units = int(np.asarray(rb.offsets)[-1])
     padded_units = int(pb.units.shape[0] * pb.units.shape[1])
     out = {
         "config": config,
+        "ingest": ingest,
         "batch": batch_size,
         "units_padding_fraction": round(1 - real_units / padded_units, 4),
         "padded_wire_bytes": wire_bytes(pb),
@@ -101,12 +144,12 @@ def main(argv=None) -> None:
         return model, featurize
 
     arms = {
-        "padded": make(lambda c: feat.featurize_batch_units(
-            c, row_bucket=batch_size, pre_filtered=True)),
-        "ragged": make(lambda c: feat.featurize_batch_ragged(
-            c, row_bucket=batch_size, pre_filtered=True)),
+        "padded": make(fz_padded),
+        "ragged": make(fz_ragged),
     }
-    n = sum(len(c) for c in chunks)
+    n = sum(
+        c.rows if hasattr(c, "rows") else len(c) for c in chunks
+    )  # block chunks count rows, Status chunks count items
     times: dict[str, list] = {k: [] for k in arms}
     finals: dict[str, float] = {}
     t_end = _time.perf_counter() + budget

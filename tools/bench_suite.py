@@ -242,7 +242,14 @@ def run_config(name: str, n_tweets: int, batch_size: int) -> dict:
             n_chunks = -(-rows // batch_size)
 
             def featurize(sub):
-                return feat.featurize_parsed_block(sub, row_bucket=batch_size)
+                # ragged wire from blocks (r3): the block already holds
+                # concatenated units + offsets, so no pad copy at all —
+                # measured +28% paired over the padded block wire through
+                # the tunnel (121 interleaved rounds, tools/bench_ragged.py
+                # --ingest block)
+                return feat.featurize_parsed_block(
+                    sub, row_bucket=batch_size, ragged=True
+                )
 
             # warm the compile caches for both the full and the tail chunk
             for sub in iter_row_chunks([block], batch_size):

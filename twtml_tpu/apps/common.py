@@ -506,6 +506,13 @@ class FetchPipeline:
         if self.boundary_every and self._dispatched % self.boundary_every == 0:
             self._drain()  # cadence point: weights current for checkpoints
 
+    def refund_dispatch(self) -> None:
+        """Give back one ``max_dispatch`` slot — called by handlers that
+        SKIP a delivered batch (multi-host globally-empty batches: they
+        dispatch for collective alignment but must not count toward a
+        max-batches cap, or capped runs under-train)."""
+        self._dispatched -= 1
+
     def flush(self) -> None:
         self._drain()
         self._pool.shutdown(wait=False)
@@ -590,12 +597,17 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
     if multihost:
         # the LOCAL batch can't gate the step (collectives above), but a
         # GLOBALLY empty batch (every row filtered out on every host) must
-        # not surface to the app — single-host runs skip those pre-step
+        # not surface to the app — single-host runs skip those pre-step.
+        # It must not consume a max-batches slot either (refund below, set
+        # once the pipeline exists).
         inner_handle = handle
+        pipeline_ref: list = []
 
         def handle(out, batch, t, at_boundary=True):  # noqa: F811
             if int(out.count) == 0:
                 log.debug("batch: 0 (global)")
+                if pipeline_ref:
+                    pipeline_ref[0].refund_dispatch()
                 return
             inner_handle(out, batch, t, at_boundary=at_boundary)
 
@@ -617,6 +629,8 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
                 ),
                 max_dispatch=max_dispatch,
             )
+            if multihost:
+                pipeline_ref.append(pipe)  # empty-batch refunds (above)
             stream.foreach_batch(skip_empty(pipe.on_batch))
             return pipe.flush, 1
 

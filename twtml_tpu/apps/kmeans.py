@@ -93,13 +93,13 @@ def featurize(status: Status) -> np.ndarray:
 
 
 def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> dict:
-    init_distributed(conf)  # every entry point forms the group (apps/common)
+    lead = init_distributed(conf)  # every entry point forms the group
     select_backend(conf)
-    if jax.process_count() > 1:
+    multihost = jax.process_count() > 1
+    if multihost and conf.batchBucket <= 0:
         raise SystemExit(
-            "multi-host k-means intake is not wired (its raw dense pipeline "
-            "pads rows per batch, which multi-host lockstep can't shape-pin "
-            "yet); --coordinator supports the linear/logistic entry points"
+            "multi-host k-means needs --batchBucket: every host must "
+            "dispatch the same fixed-shape collective program each tick"
         )
     # k-means keeps ALL retweets (isRetweet only, NO retweet-count interval —
     # KMeans.scala:77-80): block ingest overrides the parser's interval
@@ -114,8 +114,9 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
     # outages. ALL chart network IO (create + per-batch appends) lives on one
     # daemon thread behind a drop-oldest queue: urlopen's timeout doesn't
     # bound DNS resolution, so neither startup nor the batch loop may ever
-    # wait on the resolver; a slow chart just skips frames.
-    chart_q = _start_chart_worker(conf)
+    # wait on the resolver; a slow chart just skips frames. One chart per
+    # RUN: the lead owns it (multi-host followers train silently).
+    chart_q = _start_chart_worker(conf) if lead else None
 
     # mesh-sharded clustering on several devices / --master local[N]: rows
     # shard over 'data', per-center sums psum over ICI (models/kmeans.py)
@@ -141,7 +142,90 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
             st["centers"], st["weights"]
         ),
         totals=totals,
+        lead=lead,
     )
+
+    # multi-host: the fixed per-host row shape (lockstep drains cap at it)
+    local_bucket = (
+        pad_row_count(
+            conf.batchBucket, conf.batchBucket,
+            max(1, model.num_data // jax.process_count()),
+        )
+        if multihost
+        else 0
+    )
+
+    def on_batch_multihost(statuses: list[Status], _batch_time) -> None:
+        """Per-host sharded k-means batch: local rows → one global
+        row-sharded point matrix (`host_local_rows_to_global`), the
+        per-batch StandardScaler computed GLOBALLY (jit over the global
+        array — XLA inserts the mean/var collectives), and the mesh
+        update's per-center psums span every host. A host with no rows
+        still dispatches (all-padding — the update is a state no-op when
+        the GLOBAL batch is empty, models/kmeans.py)."""
+        from jax.experimental import multihost_utils
+
+        from ..parallel.distributed import (
+            host_local_rows_to_global,
+            local_rows,
+        )
+
+        retweets = [s for s in statuses if s.is_retweet]
+        if len(retweets) > local_bucket:
+            log.error(
+                "dropping %d rows over --batchBucket in multi-host "
+                "lockstep (raise --batchBucket)",
+                len(retweets) - local_bucket,
+            )
+            retweets = retweets[:local_bucket]
+        n = len(retweets)
+        pts = np.zeros((local_bucket, NUM_DIMENSIONS), np.float32)
+        if n:
+            pts[:n] = np.stack([featurize(s) for s in retweets])
+        mask = np.zeros((local_bucket,), np.float32)
+        mask[:n] = 1.0
+        g_pts = host_local_rows_to_global(pts, model.mesh)
+        g_mask = host_local_rows_to_global(mask, model.mesh)
+        scaled_g = scale(g_pts, g_mask)
+        assign = model.update(scaled_g, g_mask)[:n]  # this host's rows
+        centers = model.latest_centers
+        sl = local_rows(scaled_g)[:n]
+        pred = (
+            np.argmin(
+                ((sl[:, None, :] - centers[None]) ** 2).sum(-1), axis=1
+            )
+            if n
+            else np.zeros((0,), np.int64)
+        )
+        # ONE tiny allgather agrees global count + global cluster sizes
+        # (every host calls it — lockstep keeps the order aligned)
+        agg = multihost_utils.process_allgather(
+            np.concatenate(
+                [[n], np.bincount(pred, minlength=NUM_CLUSTERS)]
+            ).astype(np.int64)
+        ).sum(axis=0)
+        n_global, sizes = int(agg[0]), agg[1:]
+        if n_global == 0:
+            log.debug("batch: 0 (global)")  # the update was a state no-op
+            return
+        totals["count"] += n_global
+        totals["batches"] += 1
+        if lead:
+            print(
+                f"count: {totals['count']}  batch: {n_global}  "
+                f"centers: {np.round(centers, 3).tolist()}  "
+                f"sizes: {sizes.tolist()}",
+                flush=True,
+            )
+            log.debug("assignments: %s", assign.tolist())
+            m = min(n, CHART_MAX_POINTS)
+            try:
+                chart_q.put_nowait((sl[:m, 0], sl[:m, 1], pred[:m]))
+            except queue.Full:
+                pass
+        ckpt.maybe_save(totals)
+        if max_batches and totals["batches"] >= max_batches:
+            ssc.request_stop()
 
     def _rows_for(n: int) -> int:
         """The central padding policy (features/batch.py): power-of-two
@@ -199,10 +283,17 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
         if max_batches and totals["batches"] >= max_batches:
             ssc.request_stop()
 
-    ssc.raw_stream(source).foreach_batch(on_batch)
+    # --batchBucket caps back-to-back drains in single-host mode too, so
+    # replay batching is deterministic (and the multi-host fixed shape)
+    ssc.raw_stream(
+        source,
+        row_bucket=local_bucket if multihost else max(0, conf.batchBucket),
+    ).foreach_batch(on_batch_multihost if multihost else on_batch)
     try:
-        if wall_clock:
-            ssc.start()
+        if wall_clock or multihost:
+            # multi-host always uses the lockstep scheduler (collective
+            # cadence agreement), whatever the batch interval
+            ssc.start(lockstep=multihost)
             try:
                 ssc.await_termination()
             except KeyboardInterrupt:
@@ -215,6 +306,11 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
         # like the sibling apps: the shutdown save must survive a handler
         # exception or Ctrl-C (run_to_completion raises on the main thread)
         ckpt.final_save(totals)
+    if ssc.failed:
+        raise RuntimeError(
+            "multi-host lockstep run aborted (see critical log above); "
+            "progress up to the failure is checkpointed"
+        )
     return totals
 
 

@@ -70,6 +70,11 @@ def _update_step(centers, weights, points, mask, decay_factor, time_unit,
         discount = jnp.asarray(decay_factor, points.dtype)
     else:
         discount = jnp.asarray(decay_factor, points.dtype) ** num_points
+    # an all-padding batch must be a STATE NO-OP: single-host callers skip
+    # empty batches before update (apps/kmeans.py, KMeans.scala semantics),
+    # but multi-host lockstep DISPATCHES them for collective alignment
+    # (streaming/context.py) — no decay, no dying-cluster split
+    discount = jnp.where(num_points > 0, discount, 1.0)
 
     n = weights * discount
     denom = jnp.maximum(n + counts, 1e-16)
@@ -83,7 +88,7 @@ def _update_step(centers, weights, points, mask, decay_factor, time_unit,
     smallest = jnp.argmin(new_weights)
     max_w = new_weights[largest]
     min_w = new_weights[smallest]
-    dying = min_w < 1e-8 * max_w
+    dying = (min_w < 1e-8 * max_w) & (num_points > 0)
 
     half = (max_w + min_w) / 2.0
     c_large = new_centers[largest]
@@ -186,6 +191,16 @@ class StreamingKMeans:
         self.centers, self.cluster_weights, assign = self._get_step()(
             self.centers, self.cluster_weights, points, mask
         )
+        if (
+            isinstance(assign, jax.Array)
+            and not assign.is_fully_addressable
+        ):
+            # multi-host mesh: each host gets ITS rows' assignments (the
+            # rows it contributed — process-aligned data axis), in global
+            # row order; per-row telemetry never crosses hosts
+            from ..parallel.distributed import local_rows
+
+            return local_rows(assign)
         return np.asarray(assign)
 
     def predict(self, points) -> np.ndarray:

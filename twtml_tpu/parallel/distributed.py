@@ -59,6 +59,36 @@ def initialize(
         log.debug("jax.distributed not initialized (%s); single-process mode", exc)
 
 
+def local_rows(arr) -> np.ndarray:
+    """This process's rows of a row-sharded global array, in global row
+    order (shards sorted by their global offset). Per-shard device→host
+    copies start async so they overlap each other; the fetch itself is
+    synchronous."""
+    shards = sorted(
+        arr.addressable_shards, key=lambda s: s.index[0].start or 0
+    )
+    for s in shards:
+        s.data.copy_to_host_async()
+    return np.concatenate([np.asarray(s.data) for s in shards])
+
+
+def host_local_rows_to_global(arr: np.ndarray, mesh):
+    """Plain per-host [B_local, ...] rows → one global row-sharded array —
+    the dense-array sibling of ``host_local_batch_to_global`` (the k-means
+    pipeline ships dense point matrices, not featurized batches). Requires
+    the process-aligned data axis, like per-host batch intake."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    arr = np.asarray(arr)
+    spec = P(mesh.axis_names[0], *([None] * (arr.ndim - 1)))
+    if jax.process_count() == 1:
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+    global_shape = (arr.shape[0] * jax.process_count(),) + arr.shape[1:]
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), arr, global_shape
+    )
+
+
 class MultiHostSGDModel:
     """Per-host sharded intake over a multi-process mesh, with the same step
     surface the apps consume (apps/common.build_model): LOCAL host batches
@@ -88,20 +118,8 @@ class MultiHostSGDModel:
         self.inner.set_initial_weights(weights)
         return self
 
-    @staticmethod
-    def _local_rows(arr) -> np.ndarray:
-        """This process's rows of a row-sharded global array, in global row
-        order (shards sorted by their global offset). The per-shard
-        device→host copies are started async first so they overlap each
-        other; the fetch itself is still synchronous per step — a known
-        cost of the multi-host telemetry path (SCALING.md §4), not of the
-        single-host pipeline the lag fetch optimizes."""
-        shards = sorted(
-            arr.addressable_shards, key=lambda s: s.index[0].start or 0
-        )
-        for s in shards:
-            s.data.copy_to_host_async()
-        return np.concatenate([np.asarray(s.data) for s in shards])
+    # the module-level helper, kept as a method name for call sites
+    _local_rows = staticmethod(local_rows)
 
     def step(self, local_batch):
         out = self.inner.step(

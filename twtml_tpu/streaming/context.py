@@ -63,10 +63,15 @@ class RawStream:
     """A stream of raw Status lists — for apps with their own featurization
     (the k-means entry featurizes to a dense pair, KMeans.scala:19-33).
     Outputs fire per micro-batch in registration order (reference: foreachRDD
-    at LinearRegression.scala:53, trainOn at :86)."""
+    at LinearRegression.scala:53, trainOn at :86).
 
-    def __init__(self):
+    ``row_bucket`` (optional) caps the scheduler's back-to-back drains —
+    required by multi-host lockstep, where the app's per-batch handler owns
+    fixed-shape padding and every host must dispatch the same program."""
+
+    def __init__(self, row_bucket: int = 0):
         self._outputs: list[Callable] = []
+        self.row_bucket = row_bucket
 
     def foreach_batch(self, fn) -> "RawStream":
         self._outputs.append(fn)
@@ -241,13 +246,14 @@ class StreamingContext:
         )
         return self._stream
 
-    def raw_stream(self, source: Source) -> RawStream:
+    def raw_stream(self, source: Source, row_bucket: int = 0) -> RawStream:
         """Attach the source with no featurization — outputs receive the raw
-        Status list per micro-batch."""
+        Status list per micro-batch. ``row_bucket`` caps back-to-back
+        drains (required in multi-host lockstep)."""
         if self._source is not None:
             raise ValueError("StreamingContext supports one source stream")
         self._source = source
-        self._stream = RawStream()
+        self._stream = RawStream(row_bucket)
         return self._stream
 
     def _drain(self, limit: int = 0) -> list[Status]:
@@ -307,8 +313,9 @@ class StreamingContext:
 
     @property
     def stop_requested(self) -> bool:
-        """Whether a stop has been requested (read by the lagged-fetch
-        pipeline to honor max-batches caps exactly, apps/common.py)."""
+        """Whether a stop has been requested (read by the concurrent
+        fetch pipeline to honor max-batches caps exactly, apps/common.py
+        FetchPipeline)."""
         return self._stop.is_set()
 
     def _run_batch_aligned(self, statuses: list[Status], batch_time: float) -> None:
@@ -322,6 +329,16 @@ class StreamingContext:
         the loop: after a possible partial dispatch alignment is unknowable,
         and failing fast beats a distributed hang."""
         stream = self._stream
+        if not isinstance(stream, FeatureStream):
+            # raw lockstep (the k-means entry): the app's per-batch handler
+            # owns fixed-shape padding and global assembly, so there is no
+            # featurize stage to guard here; handler failures propagate to
+            # the loop's abort path (alignment unknowable after a possible
+            # partial dispatch)
+            for fn in stream._outputs:
+                fn(statuses, batch_time)
+            self.batches_processed += 1
+            return
         try:
             batch = stream._featurize(statuses)
         except Exception:
