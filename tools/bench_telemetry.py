@@ -112,12 +112,53 @@ def main(argv=None) -> None:
                 consume(f.result(), b, 0.0)
         return time.perf_counter() - t0
 
+    from twtml_tpu.features.batch import stack_batches
+    from twtml_tpu.models.base import StepOutput
+
+    groups = [
+        stack_batches(batches[i : i + 8])
+        for i in range(0, len(batches) - len(batches) % 8, 8)
+    ]
+    tail = batches[len(batches) - len(batches) % 8 :]
+    if groups:
+        float(model.step_many(groups[0]).mse[-1])  # warm the scan program
+
+    def super_pool_pass(workers=4):
+        """--superBatch 8 + pooled group fetches: one scan dispatch and one
+        pooled fetch per 8 batches — the two levers stacked. The per-batch
+        consume() runs here too, so every arm measures the same handler
+        work."""
+        model.reset()
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            futs = [
+                (pool.submit(jax.device_get, model.step_many(g)), True)
+                for g in groups
+            ] + [
+                (pool.submit(jax.device_get, model.step(b)), False)
+                for b in tail
+            ]
+            for f, stacked in futs:
+                host = f.result()
+                if stacked:
+                    for k in range(host.count.shape[0]):
+                        consume(
+                            StepOutput(*(x[k] for x in host)), None, 0.0
+                        )
+                else:
+                    consume(host, None, 0.0)
+        return time.perf_counter() - t0
+
     times = {"sync": [], "lag": [], "pool8": []}
+    if groups:
+        times["super8_pool4"] = []
     t_end = time.perf_counter() + budget
     while time.perf_counter() < t_end:
         times["sync"].append(sync_pass())
         times["lag"].append(lag_pass())
         times["pool8"].append(pool_pass())
+        if groups:
+            times["super8_pool4"].append(super_pool_pass())
 
     out = {"regime": "per-batch-telemetry", "batch": batch,
            "tweets": n_tweets, "backend": jax.default_backend(),
@@ -127,7 +168,7 @@ def main(argv=None) -> None:
             "tweets_per_sec_best": round(n_tweets / min(ts), 1),
             "tweets_per_sec_median": round(n_tweets / statistics.median(ts), 1),
         }
-    for name in ("lag", "pool8"):
+    for name in [k for k in ("lag", "pool8", "super8_pool4") if k in times]:
         out[name]["paired_speedup_vs_sync"] = round(
             statistics.median(
                 [s / t for s, t in zip(times["sync"], times[name])]

@@ -1,0 +1,100 @@
+"""Endurance soak: alternate the flagship configs back-to-back on the chip
+and assert numeric bit-stability — the r2/r3 reliability evidence
+(BENCHMARKS.md "Endurance soaks").
+
+Each round runs, on the SAME process/models: the dense ragged-wire pipeline
+(the r3 headline path) and the 2^18 Gram config at its r3 operating point
+(batch 1024, ragged). Every pass resets weights and streams the identical
+corpus, so the final-batch mse must be BIT-IDENTICAL on every pass — any
+drift, leak-induced slowdown, or transport wedge fails loudly.
+
+Usage: python tools/soak.py [--minutes M] [--tweets N]
+Prints one JSON line at the end.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import resource
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    minutes, n_tweets = 15.0, 65536
+    i = 0
+    while i < len(args):
+        if args[i] == "--minutes":
+            minutes = float(args[i + 1]); i += 2
+        elif args[i] == "--tweets":
+            n_tweets = int(args[i + 1]); i += 2
+        else:
+            raise SystemExit(f"unknown flag {args[i]!r}")
+
+    import jax
+
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.models import StreamingLinearRegressionWithSGD
+    from twtml_tpu.streaming.sources import SyntheticSource
+    from twtml_tpu.utils.benchloop import _run_once
+
+    statuses = list(SyntheticSource(total=n_tweets, seed=3).produce())
+
+    def arm(f_text, batch, l2):
+        feat = Featurizer(num_text_features=f_text, now_ms=1785320000000)
+        chunks = [
+            statuses[i : i + batch] for i in range(0, len(statuses), batch)
+        ]
+
+        def fz(c):
+            return feat.featurize_batch_ragged(
+                c, row_bucket=batch, pre_filtered=True
+            )
+
+        model = StreamingLinearRegressionWithSGD(
+            num_text_features=f_text, l2_reg=l2
+        )
+        float(model.step(fz(chunks[0])).mse)  # warm
+        return model, fz, chunks
+
+    arms = {
+        "dense_ragged_b2048": arm(1000, 2048, 0.0),
+        "hash2e18_ragged_b1024": arm(2**18, 1024, 0.1),
+    }
+    reference_mse: dict[str, float] = {}
+    passes = {k: 0 for k in arms}
+    rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    t_end = time.perf_counter() + minutes * 60
+    while time.perf_counter() < t_end:
+        for name, (model, fz, chunks) in arms.items():
+            model.reset()
+            _, last = _run_once(model, fz, chunks, prefetch=True)
+            mse = float(last.mse)
+            if name not in reference_mse:
+                reference_mse[name] = mse
+            elif mse != reference_mse[name]:
+                raise SystemExit(
+                    f"NUMERIC DRIFT in {name} pass {passes[name]}: "
+                    f"{mse} != {reference_mse[name]}"
+                )
+            passes[name] += 1
+    rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    print(json.dumps({
+        "soak_minutes": minutes,
+        "tweets_per_pass": n_tweets,
+        "passes": passes,
+        "tweets_total": sum(passes.values()) * n_tweets,
+        "final_mse": reference_mse,
+        "bit_identical": True,
+        "rss_growth_mb": round((rss1 - rss0) / 1024, 1),
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

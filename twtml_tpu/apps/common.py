@@ -365,67 +365,129 @@ class SuperBatcher:
 
     Why: in replay/back-to-back regimes every per-batch stats fetch costs a
     full transport round trip (~100 ms through this build's TPU tunnel —
-    BENCHMARKS.md), capping the telemetry-on path at ~17k tweets/s; fetching
-    K batches' stats as one array lifts that ~K× (measured ~17k → ~100k at
-    K=8, batch 2048). Semantics are unchanged: batch boundaries, per-batch
-    stats, predict-then-train ordering, and final weights are bitwise those
-    of K sequential ``step`` calls (tests/test_superbatch.py). Requires
-    pinned batch buckets (every grouped batch must share one shape).
+    BENCHMARKS.md), capping the telemetry-on path at ~17k tweets/s. The
+    scan fetches K batches' stats as one array (~K×), and r3 additionally
+    POOLS the group fetches (``fetch_depth`` concurrent in-order
+    ``device_get``s, the FetchPipeline mechanism): measured, the combined
+    form beats either lever alone — 6.7× vs sync in its window vs 4.5×
+    for pooled singles (tools/bench_telemetry.py ``super8_pool4``).
+    Semantics are unchanged: batch boundaries, per-batch stats,
+    predict-then-train ordering, and final weights are bitwise those of K
+    sequential ``step`` calls (tests/test_superbatch.py). Requires pinned
+    batch buckets (every grouped batch must share one shape).
 
     ``handle(out, batch, batch_time)`` receives plain-numpy per-batch
-    outputs; call ``flush()`` after the stream terminates to drain a
-    partial final group.
+    outputs in order; ``at_boundary`` is True only when the model's
+    weights are current as of that batch (group tail with nothing newer
+    dispatched — drains at ``boundary_every`` cadence points keep
+    checkpoint saves correct). ``max_dispatch`` caps trained batches at
+    group granularity (the documented up-to-K−1 overshoot). Call
+    ``flush()`` after the stream terminates.
 
     Only contiguous SAME-SHAPE batches group (one compiled scan program): a
     batch that overflowed a pinned bucket, or flipped the units wire dtype,
-    flushes the pending group first and starts its own — it is never
+    closes the pending group first and starts its own — it is never
     silently dropped, and partial groups run as plain steps (identical
     math, no one-off scan compiles at odd lengths)."""
 
-    def __init__(self, model, k: int, handle):
+    def __init__(self, model, k: int, handle, fetch_depth: int = 4,
+                 boundary_every: int = 0, max_dispatch: int = 0):
+        from concurrent.futures import ThreadPoolExecutor
+
         self.model = model
         self.k = k
         self.handle = handle
+        self.fetch_depth = max(1, fetch_depth)
+        self.max_dispatch = max_dispatch
+        # cadence drains in GROUPS: the first group boundary at/after each
+        # cadence point, matching the pre-r3 boundary-snap contract
+        self._boundary_groups = (
+            -(-boundary_every // k) if boundary_every else 0
+        )
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.fetch_depth,
+            thread_name_prefix="twtml-group-fetch",
+        )
         self._buf: list = []
         self._sig = None
+        self._inflight: list = []  # [(future, group)] oldest first
+        self._groups = 0
+        self._dispatched = 0
 
     @staticmethod
     def _signature(batch):
         return (type(batch),) + tuple((a.shape, a.dtype) for a in batch)
 
     def on_batch(self, batch, batch_time) -> None:
+        if self.max_dispatch and self._dispatched >= self.max_dispatch:
+            # cap reached: deliver what trained so the handler-side stop
+            # fires (see FetchPipeline), train nothing more
+            self._drain()
+            return
         sig = self._signature(batch)
         if self._buf and sig != self._sig:
-            self.flush()  # shape/dtype changed: close the group, never drop
+            self._close_group()  # shape/dtype changed: close, never drop
         self._sig = sig
         self._buf.append((batch, batch_time))
         if len(self._buf) >= self.k:
-            self.flush()
+            self._close_group()
 
-    def flush(self) -> None:
+    def _emit_group(self) -> None:
+        from ..models.base import StepOutput
+
+        future, group = self._inflight.pop(0)
+        host = future.result()
+        last = len(group) - 1
+        boundary_ok = not self._inflight and not self._buf
+        for k, (batch, t) in enumerate(group):
+            self.handle(
+                StepOutput(*(f[k] for f in host)), batch, t,
+                at_boundary=(k == last and boundary_ok),
+            )
+
+    def _drain(self) -> None:
+        while self._inflight:
+            self._emit_group()
+
+    def _close_group(self) -> None:
         if not self._buf:
             return
         import jax
 
         from ..features.batch import stack_batches
-        from ..models.base import StepOutput
 
         group, self._buf = self._buf, []
         if len(group) < self.k:
             # partial group (tail, or a shape change): plain steps — the
-            # same math, and no fresh scan compile for a one-off length
+            # same math, and no fresh scan compile for a one-off length.
+            # Earlier groups must emit first (strict batch order), and the
+            # max_dispatch cap binds here exactly like on full groups.
+            self._drain()
             for batch, t in group:
+                if self.max_dispatch and self._dispatched >= self.max_dispatch:
+                    return
                 out = jax.device_get(self.model.step(batch))
+                self._dispatched += 1
                 self.handle(out, batch, t, at_boundary=True)
             return
+        # backpressure + timeliness, as in FetchPipeline
+        while len(self._inflight) >= self.fetch_depth or (
+            self._inflight and self._inflight[0][0].done()
+        ):
+            self._emit_group()
         outs = self.model.step_many(stack_batches([b for b, _ in group]))
-        host = jax.device_get(outs)  # ONE transfer for all K batches' stats
-        last = len(group) - 1
-        for k, (batch, t) in enumerate(group):
-            self.handle(
-                StepOutput(*(f[k] for f in host)), batch, t,
-                at_boundary=(k == last),
-            )
+        self._inflight.append(
+            (self._pool.submit(jax.device_get, outs), group)
+        )
+        self._dispatched += len(group)
+        self._groups += 1
+        if self._boundary_groups and self._groups % self._boundary_groups == 0:
+            self._drain()  # cadence point: weights current for checkpoints
+
+    def flush(self) -> None:
+        self._close_group()  # a partial tail drains inflight itself
+        self._drain()
+        self._pool.shutdown(wait=False)
 
 
 class FetchPipeline:
@@ -611,6 +673,15 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
                 return
             inner_handle(out, batch, t, at_boundary=at_boundary)
 
+    # cadence drains exist for checkpoint saves only: without a
+    # checkpointDir each drain would stall the fetch pipelining for a
+    # no-op save (one rule for both the k=1 and superbatch paths)
+    boundary_every = (
+        int(getattr(conf, "checkpointEvery", 0) or 0)
+        if getattr(conf, "checkpointDir", "")
+        else 0
+    )
+
     if k <= 1:
         if conf.seconds <= 0:
             # back-to-back: concurrent in-order stats fetches pipeline the
@@ -619,14 +690,7 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
             # so saves see current weights
             pipe = FetchPipeline(
                 model, handle, stop_requested=stop_requested,
-                # cadence drains exist for checkpoint saves only: without a
-                # checkpointDir each drain would stall the pipeline (and
-                # the 6.2x win) for a no-op save
-                boundary_every=(
-                    int(getattr(conf, "checkpointEvery", 0) or 0)
-                    if getattr(conf, "checkpointDir", "")
-                    else 0
-                ),
+                boundary_every=boundary_every,
                 max_dispatch=max_dispatch,
             )
             if multihost:
@@ -646,7 +710,11 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
         stream.foreach_batch(skip_empty(per_batch))
         return (lambda: None), 1
 
-    batcher = SuperBatcher(model, k, handle)
+    batcher = SuperBatcher(
+        model, k, handle,
+        boundary_every=boundary_every,
+        max_dispatch=max_dispatch,
+    )
     stream.foreach_batch(skip_empty(batcher.on_batch))
     return batcher.flush, k
 

@@ -335,8 +335,7 @@ class StreamingContext:
             # featurize stage to guard here; handler failures propagate to
             # the loop's abort path (alignment unknowable after a possible
             # partial dispatch)
-            for fn in stream._outputs:
-                fn(statuses, batch_time)
+            stream._process(statuses, batch_time)
             self.batches_processed += 1
             return
         try:
