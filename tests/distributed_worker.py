@@ -40,6 +40,54 @@ def main() -> None:
         f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid
     )
 
+    if mesh_kind == "lockstep_abort":
+        # the anti-hang machinery: host 1's batch handler raises mid-run;
+        # its loop must broadcast abort so host 0 STOPS (instead of
+        # stalling in its next collective), and BOTH mark the run failed
+        from twtml_tpu.features.featurizer import Featurizer
+        from twtml_tpu.parallel import ParallelSGDModel, make_mesh
+        from twtml_tpu.parallel.distributed import host_local_batch_to_global
+        from twtml_tpu.streaming.context import StreamingContext
+        from twtml_tpu.streaming.sources import ShardedSource, SyntheticSource
+
+        mesh = make_mesh(num_data=len(jax.devices()), devices=jax.devices())
+        model = ParallelSGDModel(mesh, num_iterations=5, step_size=0.005)
+        ssc = StreamingContext(batch_interval=0)
+        stream = ssc.source_stream(
+            ShardedSource(
+                SyntheticSource(total=256, seed=7, base_ms=1785320000000),
+                pid, nprocs,
+            ),
+            Featurizer(now_ms=1785320000000),
+            row_bucket=16, token_bucket=64, row_multiple=2,
+            device_hash=True,
+        )
+        seen = {"n": 0}
+
+        def on_batch(batch, t):
+            seen["n"] += 1
+            model.step(host_local_batch_to_global(batch, mesh))
+            if pid == 1 and seen["n"] == 3:
+                # post-dispatch handler failure: the recoverable class —
+                # this host's collective program DID run, so the peer's
+                # collectives complete and the abort flag can reach it on
+                # the next tick. (A failure BEFORE dispatch deadlocks the
+                # peer's in-order collective queue until runtime timeouts —
+                # the documented unrecoverable class.)
+                raise RuntimeError("injected handler failure on host 1")
+
+        stream.foreach_batch(on_batch)
+        ssc.start(lockstep=True)
+        terminated = ssc.await_termination(timeout=60)
+        ssc.stop()
+        print(json.dumps({
+            "process": pid,
+            "terminated": bool(terminated),
+            "failed": bool(ssc.failed),
+            "batches_seen": seen["n"],
+        }), flush=True)
+        return
+
     import numpy as np
 
     from twtml_tpu.features.featurizer import Featurizer

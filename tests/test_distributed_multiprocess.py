@@ -324,3 +324,14 @@ def test_two_process_2d_mesh_gram_inner_loop():
     _, mse, weights = _single_process_expectation("unit")
     assert outs[0]["mse"] == pytest.approx(mse, rel=1e-4)
     np.testing.assert_allclose(outs[0]["weights"], weights, rtol=1e-4, atol=1e-6)
+
+
+def test_lockstep_abort_propagates_instead_of_hanging():
+    """A batch failure on one host aborts the GROUP: the failing host
+    broadcasts abort on its next tick, the healthy peer stops instead of
+    stalling in its next collective, and both mark the run failed."""
+    outs = _run_group("unit", mesh="lockstep_abort", timeout=120.0)
+    by_pid = {o["process"]: o for o in outs}
+    assert by_pid[0]["terminated"] and by_pid[1]["terminated"]
+    assert by_pid[0]["failed"] and by_pid[1]["failed"]
+    assert by_pid[1]["batches_seen"] == 3  # raised on its third batch

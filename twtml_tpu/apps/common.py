@@ -399,11 +399,11 @@ class SuperBatcher:
         self.handle = handle
         self.fetch_depth = max(1, fetch_depth)
         self.max_dispatch = max_dispatch
-        # cadence drains in GROUPS: the first group boundary at/after each
-        # cadence point, matching the pre-r3 boundary-snap contract
-        self._boundary_groups = (
-            -(-boundary_every // k) if boundary_every else 0
-        )
+        # cadence drains count DISPATCHED BATCHES (partial groups included),
+        # honoring the pre-r3 contract: the first boundary at/after each
+        # cadence point
+        self.boundary_every = boundary_every
+        self._last_boundary = 0
         self._pool = ThreadPoolExecutor(
             max_workers=self.fetch_depth,
             thread_name_prefix="twtml-group-fetch",
@@ -411,7 +411,6 @@ class SuperBatcher:
         self._buf: list = []
         self._sig = None
         self._inflight: list = []  # [(future, group)] oldest first
-        self._groups = 0
         self._dispatched = 0
 
     @staticmethod
@@ -438,7 +437,9 @@ class SuperBatcher:
         future, group = self._inflight.pop(0)
         host = future.result()
         last = len(group) - 1
-        boundary_ok = not self._inflight and not self._buf
+        # _buf is provably empty at every emit site, so the pipeline being
+        # drained is the whole weights-current condition
+        boundary_ok = not self._inflight
         for k, (batch, t) in enumerate(group):
             self.handle(
                 StepOutput(*(f[k] for f in host)), batch, t,
@@ -480,9 +481,11 @@ class SuperBatcher:
             (self._pool.submit(jax.device_get, outs), group)
         )
         self._dispatched += len(group)
-        self._groups += 1
-        if self._boundary_groups and self._groups % self._boundary_groups == 0:
+        if self.boundary_every and (
+            self._dispatched - self._last_boundary >= self.boundary_every
+        ):
             self._drain()  # cadence point: weights current for checkpoints
+            self._last_boundary = self._dispatched
 
     def flush(self) -> None:
         self._close_group()  # a partial tail drains inflight itself
