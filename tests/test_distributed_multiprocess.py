@@ -335,3 +335,32 @@ def test_lockstep_abort_propagates_instead_of_hanging():
     assert by_pid[0]["terminated"] and by_pid[1]["terminated"]
     assert by_pid[0]["failed"] and by_pid[1]["failed"]
     assert by_pid[1]["batches_seen"] == 3  # raised on its third batch
+
+
+def test_app_level_multihost_wall_clock_intervals(tmp_path):
+    """The lockstep scheduler's WALL-CLOCK branch (--seconds > 0): hosts
+    tick on their own clocks, the per-tick allgather aligns them, and the
+    run completes with all rows trained and one telemetry owner."""
+    import json as _json
+
+    from tools.bench_suite import _status_json
+    from twtml_tpu.streaming.sources import SyntheticSource
+
+    path = tmp_path / "tweets.jsonl"
+    with open(path, "w") as fh:
+        for s in SyntheticSource(total=64, seed=8, base_ms=1785320000000).produce():
+            fh.write(_json.dumps(_status_json(s)) + "\n")
+
+    closed = "http://127.0.0.1:9"
+    multi = _run_app_group([
+        "linear", "--source", "replay", "--replayFile", str(path),
+        "--seconds", "1", "--backend", "cpu",
+        "--batchBucket", "16", "--tokenBucket", "64",
+        "--lightning", closed, "--twtweb", closed,
+    ], nprocs=2, ndev=2)
+
+    lead = [ln for ln in multi[0].splitlines() if ln.startswith("count:")]
+    follower = [ln for ln in multi[1].splitlines() if ln.startswith("count:")]
+    assert follower == []
+    assert lead, "no stats lines from the lead"
+    assert "count: 64" in lead[-1]  # every row trained, wall-clock cadence
