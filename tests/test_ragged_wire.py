@@ -262,3 +262,19 @@ def test_linear_app_block_ragged_identical_stats(tmp_path, capsys):
     # the small file arrives as ONE parsed block (a block item overshoots
     # the row cap by design), so one batch carries all rows
     assert len(lines_p) >= 1 and totals_p["count"] == 80
+
+
+def test_ragged_matches_padded_logistic_sentiment_labels():
+    """Config #3's exact shape: the logistic learner with C-lexicon
+    sentiment labels (batch_label_fn reusing the featurizer's encode pass)
+    through the ragged wire — bit-identical to the padded wire."""
+    from twtml_tpu.features.sentiment import sentiment_label, sentiment_labels
+
+    assert_identical_training(
+        synthetic(n=96, seed=41),
+        model_cls=StreamingLogisticRegressionWithSGD,
+        feat_kw={
+            "label_fn": sentiment_label,
+            "batch_label_fn": sentiment_labels,
+        },
+    )

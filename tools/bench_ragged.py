@@ -12,7 +12,7 @@ reports, for a corpus at a given batch size:
     per-round ratios so tunnel phase swings hit both arms equally.
 
 Usage: python tools/bench_ragged.py [--tweets N] [--batch B] [--budget S]
-       [--config dense|2e18] [--ingest object|block]
+       [--config dense|2e18|logistic] [--ingest object|block]
 Prints one JSON line. ``--ingest block`` compares the formats fed from the
 native columnar parser's blocks (featurize_parsed_block) instead of Status
 objects — the ragged form there skips the pad copy entirely.
@@ -59,11 +59,26 @@ def main(argv=None) -> None:
     import numpy as np
 
     from twtml_tpu.features.featurizer import Featurizer
-    from twtml_tpu.models import StreamingLinearRegressionWithSGD
+    from twtml_tpu.models import (
+        StreamingLinearRegressionWithSGD,
+        StreamingLogisticRegressionWithSGD,
+    )
     from twtml_tpu.streaming.sources import SyntheticSource
 
     f_text = 2**18 if config == "2e18" else 1000
     feat = Featurizer(num_text_features=f_text, now_ms=1785320000000)
+    if config == "logistic":
+        # the suite's config #3: lexicon sentiment labels via the C batch
+        # scorer, logistic residual
+        from twtml_tpu.features.sentiment import (
+            sentiment_label,
+            sentiment_labels,
+            sentiment_labels_from_units,
+        )
+
+        feat.label_fn = sentiment_label
+        feat.batch_label_fn = sentiment_labels
+        feat.unit_label_fn = sentiment_labels_from_units  # block ingest
     statuses = list(SyntheticSource(total=n_tweets, seed=3).produce())
 
     if ingest == "block":
@@ -135,9 +150,13 @@ def main(argv=None) -> None:
     from twtml_tpu.utils.benchloop import _run_once
 
     def make(featurize):
-        model = StreamingLinearRegressionWithSGD(
-            num_text_features=f_text, l2_reg=0.1 if config == "2e18" else 0.0
-        )
+        if config == "logistic":
+            model = StreamingLogisticRegressionWithSGD()
+        else:
+            model = StreamingLinearRegressionWithSGD(
+                num_text_features=f_text,
+                l2_reg=0.1 if config == "2e18" else 0.0,
+            )
         warm = featurize(chunks[0])
         for _ in range(2):
             float(model.step(warm).mse)  # completion-fetch warmup

@@ -348,7 +348,10 @@ def run_config(name: str, n_tweets: int, batch_size: int) -> dict:
         feat.label_fn = sentiment_label
         feat.batch_label_fn = sentiment_labels
         model = StreamingLogisticRegressionWithSGD()
-        out.update(_pipeline_rate(model, feat, statuses, batch_size))
+        # ragged wire: +9.7% paired over 193 interleaved rounds
+        # (tools/bench_ragged.py --config logistic)
+        out.update(_pipeline_rate(model, feat, statuses, batch_size,
+                                  ragged=True))
     elif name == "hashing_2e18_l2":
         from twtml_tpu.models import StreamingLinearRegressionWithSGD
 
