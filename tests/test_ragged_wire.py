@@ -278,3 +278,31 @@ def test_ragged_matches_padded_logistic_sentiment_labels():
             "batch_label_fn": sentiment_labels,
         },
     )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_ragged_fuzz_random_unicode(seed):
+    """Seeded fuzz: random texts across codepoint planes — ASCII, Latin-1,
+    CJK, astral (surrogate pairs), EMPTY strings, single chars, and long
+    rows — must train bit-identically through both wires."""
+    rng = np.random.default_rng(seed)
+    pools = [
+        lambda: chr(rng.integers(32, 127)),          # ASCII
+        lambda: chr(rng.integers(0xC0, 0x17F)),      # Latin accents
+        lambda: chr(rng.integers(0x4E00, 0x4F00)),   # CJK
+        lambda: chr(rng.integers(0x1F300, 0x1F3FF)),  # astral emoji
+    ]
+    statuses = []
+    for _ in range(64):
+        kind = rng.integers(0, 8)
+        if kind == 0:
+            text = ""  # empty text row
+        elif kind == 1:
+            text = pools[rng.integers(0, 4)]()  # single char
+        else:
+            n_chars = int(rng.integers(2, 60))
+            text = "".join(
+                pools[rng.integers(0, 4)]() for _ in range(n_chars)
+            )
+        statuses.append(rt(text, label=int(rng.integers(100, 1001))))
+    assert_identical_training(statuses, rows=16)
