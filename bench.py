@@ -80,9 +80,11 @@ def measure(
         # the fused device step re-pads with one gather and hashes bigrams
         # in-program. Bit-identical features (tests/test_ragged_wire.py,
         # test_device_hash.py); measured +14% paired vs the padded wire
-        # over 76 interleaved passes (tools/bench_ragged.py, BENCHMARKS.md)
+        # over 76 interleaved passes, and PACKED into one buffer for
+        # another +11.4% paired (per-array request overhead stops hiding
+        # once the wire is lean — tools/bench_ragged.py, BENCHMARKS.md)
         return feat.featurize_batch_ragged(
-            chunk, row_bucket=batch_size, pre_filtered=True
+            chunk, row_bucket=batch_size, pre_filtered=True, pack=True
         )
 
     out = measure_pipeline(

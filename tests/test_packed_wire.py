@@ -98,3 +98,43 @@ def test_model_trajectory_bitwise_identical():
         out_q = m_plain.step(b)
         assert float(out_p.mse) == float(out_q.mse)
     np.testing.assert_array_equal(m_packed.latest_weights, m_plain.latest_weights)
+
+
+def test_packed_ragged_round_trip_and_step():
+    """RaggedUnitBatch packs into one buffer (row_len carried as static
+    layout) and trains bit-identically to the unpacked form — the shipped
+    --wire ragged transport (apps/common.FetchPipeline pack=True)."""
+    import numpy as np
+
+    from twtml_tpu.features.batch import (
+        RaggedUnitBatch,
+        pack_batch,
+        unpack_batch,
+    )
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.models import StreamingLinearRegressionWithSGD
+    from twtml_tpu.streaming.sources import SyntheticSource
+
+    statuses = list(
+        SyntheticSource(total=64, seed=17, base_ms=1785320000000).produce()
+    )
+    feat = Featurizer(now_ms=1785320000000)
+    rb = feat.featurize_batch_ragged(statuses, row_bucket=32, unit_bucket=64)
+    pk = pack_batch(rb)
+    back = unpack_batch(pk.buffer, pk.layout)
+    assert isinstance(back, RaggedUnitBatch)
+    assert back.row_len == rb.row_len
+    for a, b in zip(
+        (rb.units, rb.offsets, rb.numeric, rb.label, rb.mask),
+        (back.units, back.offsets, back.numeric, back.label, back.mask),
+    ):
+        np.testing.assert_array_equal(a, b)
+    assert pk.num_valid == rb.num_valid
+
+    plain = StreamingLinearRegressionWithSGD(num_iterations=5)
+    packed = StreamingLinearRegressionWithSGD(num_iterations=5)
+    out_a = plain.step(rb)
+    out_b = packed.step(pk)
+    for fa, fb in zip(out_a, out_b):
+        np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+    np.testing.assert_array_equal(plain.latest_weights, packed.latest_weights)

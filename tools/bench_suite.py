@@ -80,11 +80,18 @@ def _pipeline_rate(model, feat, statuses, batch_size, row_multiple=1, shard=None
 
     def featurize(chunk):
         # units wire format → bigram hashing on device (ops/text_hash.py);
-        # ragged = concatenated units, no pad bytes (features/batch.py)
-        fz = feat.featurize_batch_ragged if ragged else feat.featurize_batch_units
-        b = fz(
-            chunk, row_bucket=batch_size, pre_filtered=True,
-            row_multiple=row_multiple,
+        # ragged = concatenated units, no pad bytes, shipped as ONE packed
+        # buffer (features/batch.py — both measured wins, BENCHMARKS.md)
+        b = (
+            feat.featurize_batch_ragged(
+                chunk, row_bucket=batch_size, pre_filtered=True,
+                row_multiple=row_multiple, pack=True,
+            )
+            if ragged
+            else feat.featurize_batch_units(
+                chunk, row_bucket=batch_size, pre_filtered=True,
+                row_multiple=row_multiple,
+            )
         )
         return shard(b) if shard else b
 
@@ -248,7 +255,7 @@ def run_config(name: str, n_tweets: int, batch_size: int) -> dict:
                 # the tunnel (121 interleaved rounds, tools/bench_ragged.py
                 # --ingest block)
                 return feat.featurize_parsed_block(
-                    sub, row_bucket=batch_size, ragged=True
+                    sub, row_bucket=batch_size, ragged=True, pack=True
                 )
 
             # warm the compile caches for both the full and the tail chunk

@@ -53,7 +53,7 @@ def main(argv=None) -> None:
 
         def fz(c):
             return feat.featurize_batch_ragged(
-                c, row_bucket=batch, pre_filtered=True
+                c, row_bucket=batch, pre_filtered=True, pack=True
             )
 
         model = StreamingLinearRegressionWithSGD(

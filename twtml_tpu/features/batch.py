@@ -128,14 +128,15 @@ class PackedBatch:
 
     Why it exists: transports that expose a per-transfer cost make five
     small arrays ~1.6× the price of one 190 KB buffer (measured through
-    this build's TPU tunnel under fully-serialized upload→step→fetch). Why
-    it is NOT the default: in every regime the framework actually runs —
-    free dispatch, and per-batch telemetry fetches — the per-array overhead
-    hides behind overlapped transfers and the measured end-to-end delta is
-    zero (BENCHMARKS.md "negative results"). The learner steps accept a
+    this build's TPU tunnel under fully-serialized upload→step→fetch).
+    Status by regime (both measured): on the 188 KB PADDED wire the
+    per-array overhead hides behind overlapped transfers (r2: end-to-end
+    delta zero → opt-in), but on the lean RAGGED wire it no longer hides —
+    packing is the SHIPPED default there (+11.4% paired, r3; BENCHMARKS.md
+    "Packing stacks on ragged"). The learner steps accept a
     PackedBatch and unpack INSIDE the jit program with offset slices +
     ``lax.bitcast_convert_type`` — zero-copy reinterpretation, bit-identical
-    arrays — so opting in changes wire shape only, never semantics.
+    arrays — so packing changes wire shape only, never semantics.
 
     Registered as a pytree whose only leaf is the buffer; the layout (field
     shapes/dtypes and the batch class) is static aux data, so each distinct
@@ -242,13 +243,26 @@ def ragged_wire_arrays(
     return flat, offs
 
 
-def pack_batch(batch: "FeatureBatch | UnitBatch") -> PackedBatch:
-    """Flatten a host batch into one uint8 wire buffer (cheap memcpy)."""
-    fields = tuple(np.ascontiguousarray(a) for a in batch)
+def pack_batch(
+    batch: "FeatureBatch | UnitBatch | RaggedUnitBatch",
+) -> PackedBatch:
+    """Flatten a host batch into one uint8 wire buffer (cheap memcpy).
+    RaggedUnitBatch packs its five arrays too, with ``row_len`` carried in
+    the static layout (third element)."""
+    if isinstance(batch, RaggedUnitBatch):
+        arrays: tuple = (
+            batch.units, batch.offsets, batch.numeric, batch.label,
+            batch.mask,
+        )
+        extra: "tuple | None" = (batch.row_len,)
+    else:
+        arrays = tuple(batch)
+        extra = None
+    fields = tuple(np.ascontiguousarray(a) for a in arrays)
     layout = (
         type(batch).__name__,
         tuple((a.shape, a.dtype.str) for a in fields),
-    )
+    ) + ((extra,) if extra is not None else ())
     buffer = np.concatenate([a.view(np.uint8).reshape(-1) for a in fields])
     return PackedBatch(buffer, layout)
 
@@ -256,7 +270,11 @@ def pack_batch(batch: "FeatureBatch | UnitBatch") -> PackedBatch:
 def unpack_batch(buffer, layout: tuple):
     """Rebuild the batch from the wire buffer — works on device inside jit
     (bitcast + reshape; no data movement) and on host numpy alike."""
-    cls = {"FeatureBatch": FeatureBatch, "UnitBatch": UnitBatch}[layout[0]]
+    cls = {
+        "FeatureBatch": FeatureBatch,
+        "UnitBatch": UnitBatch,
+        "RaggedUnitBatch": RaggedUnitBatch,
+    }[layout[0]]
     fields = []
     off = 0
     for shape, dtype_str in layout[1]:
@@ -274,6 +292,8 @@ def unpack_batch(buffer, layout: tuple):
                 chunk = chunk.reshape(count, dt.itemsize)
             arr = lax.bitcast_convert_type(chunk, dt).reshape(shape)
         fields.append(arr)
+    if cls is RaggedUnitBatch:
+        return RaggedUnitBatch(*fields, row_len=layout[2][0])
     return cls(*fields)
 
 
