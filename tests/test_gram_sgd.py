@@ -111,6 +111,92 @@ def test_gram_matrix_two_plane_split_counts_above_255():
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-2)
 
 
+def test_gram_matrix_int8_plane_is_bit_exact():
+    """Row absolute mass ≤ 127 rides the s8×s8→s32 plane: integer
+    accumulation end-to-end, so the text block must equal the dense integer
+    reference EXACTLY (not allclose — the int8 plane does no rounding)."""
+    rng = np.random.default_rng(20)
+    batch = random_batch(rng)  # vals in {1,2,3}, L=12 ⇒ mass ≤ 36 ≤ 127
+    assert np.all(np.sum(np.abs(batch.token_val), axis=1) <= 127.0)
+    dense = np.asarray(
+        densify_text(jnp.asarray(batch.token_idx), jnp.asarray(batch.token_val), F_TEXT)
+    )
+    ref = dense @ dense.T
+    from twtml_tpu.ops.gram import text_gram
+
+    got = np.asarray(
+        text_gram(jnp.asarray(batch.token_idx), jnp.asarray(batch.token_val), F_TEXT)
+    )
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_gram_matrix_int8_gate_mixed_sign_boundary():
+    """Mixed-sign rows at the gate edge: absolute mass exactly 127 rides the
+    int8 plane (bit-exact, array_equal); mass 128 falls to the bf16 plane
+    (still correct — counts here are small, so bf16 is exact too; the test
+    that actually DISTINGUISHES the planes at the boundary is
+    test_gram_matrix_int8_gate_count_wrap_boundary's sign witness)."""
+    from twtml_tpu.ops.gram import text_gram
+
+    for vals, exact in [([60.0, -60.0, 7.0, 0.0], True),
+                        ([64.0, -57.0, 7.0, 0.0], False)]:
+        token_idx = np.array([[3, 3, 9, 11]], np.int32)
+        token_val = np.array([vals], np.float32)
+        dense = np.asarray(
+            densify_text(jnp.asarray(token_idx), jnp.asarray(token_val), F_TEXT)
+        )
+        ref = dense @ dense.T
+        got = np.asarray(text_gram(jnp.asarray(token_idx), jnp.asarray(token_val), F_TEXT))
+        if exact:
+            np.testing.assert_array_equal(got, ref)
+        else:
+            np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-2)
+
+
+def test_gram_matrix_int8_gate_count_wrap_boundary():
+    """A per-feature count at the int8 edge, witnessed through an
+    OFF-DIAGONAL entry (squares hide a ±wrap: (−128)² = 128²). Two rows
+    share feature 7; row0's count is 127 (int8-exact, must be array-equal)
+    or 128 (would wrap to −128 if the gate admitted it — G[0,1] flips sign,
+    so a gate loosened to ≤128, or a wrong narrowing dtype, fails here)."""
+    from twtml_tpu.ops.gram import text_gram
+
+    for count, exact in [(127.0, True), (128.0, False)]:
+        token_idx = np.array([[7, 0], [7, 0]], np.int32)
+        token_val = np.array([[count, 0.0], [1.0, 0.0]], np.float32)
+        got = np.asarray(
+            text_gram(jnp.asarray(token_idx), jnp.asarray(token_val), F_TEXT)
+        )
+        expected = np.array([[count * count, count], [count, 1.0]], np.float32)
+        if exact:
+            np.testing.assert_array_equal(got, expected)
+        else:
+            np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-2)
+        assert got[0, 1] > 0.0  # the wrap witness: sign must not flip
+
+
+def test_gram_matrix_int8_plane_disabled_still_matches():
+    """int8_plane=False rebuilds the r3 two-plane program (the bench A/B
+    baseline) and stays on the reference."""
+    from twtml_tpu.ops.gram import text_gram
+
+    rng = np.random.default_rng(21)
+    batch = random_batch(rng)
+    dense = np.asarray(
+        densify_text(jnp.asarray(batch.token_idx), jnp.asarray(batch.token_val), F_TEXT)
+    )
+    ref = dense @ dense.T
+    got = np.asarray(
+        text_gram(
+            jnp.asarray(batch.token_idx),
+            jnp.asarray(batch.token_val),
+            F_TEXT,
+            int8_plane=False,
+        )
+    )
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-4)
+
+
 def test_gram_matrix_fractional_values():
     rng = np.random.default_rng(2)
     batch = random_batch(rng)

@@ -1,0 +1,30 @@
+"""The driver contract: ``dryrun_multichip(n)`` must pass for every
+n in {1, 2, 4, 8, 16} (VERDICT r3 #7 — only n=8 had recorded evidence).
+
+The conftest pins THIS process's backend at 8 virtual CPU devices, and a
+jax backend's device count is fixed at init — so each contract point runs
+in a FRESH subprocess (the same way the driver and CI invoke it). n=16 is
+the layout where divisibility bugs hide: the 2D branch builds an
+8 data × 2 model mesh there."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("n", [1, 2, 4, 16])
+def test_dryrun_multichip_contract_point(n):
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)  # the dryrun sets its own device count
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         f"import __graft_entry__ as g; g.dryrun_multichip({n})"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert f"dryrun_multichip({n}): OK" in proc.stdout

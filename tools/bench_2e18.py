@@ -53,14 +53,18 @@ def main(argv=None) -> None:
     def chunked(b):
         return [statuses[i : i + b] for i in range(0, len(statuses), b)]
 
-    def model():
+    def model(int8=None):
+        # gram_int8 is threaded as a trace-time PARAMETER (not a module
+        # global): the ragged wire retraces per flat-buffer bucket, and a
+        # global flag would leave every post-warmup trace on the default
+        # plane — the A/B arms would silently converge
         return StreamingLinearRegressionWithSGD(
-            num_text_features=F_TEXT, l2_reg=0.1
+            num_text_features=F_TEXT, l2_reg=0.1, gram_int8=int8
         )
 
     arms: dict = {}
 
-    def pipeline_arm(name, batch, wire):
+    def pipeline_arm(name, batch, wire, int8=None):
         chunks = chunked(batch)
         fz = (
             (lambda c: feat.featurize_batch_ragged(
@@ -69,7 +73,7 @@ def main(argv=None) -> None:
             else (lambda c: feat.featurize_batch_units(
                 c, row_bucket=batch, pre_filtered=True))
         )
-        m = model()
+        m = model(int8)
         for _ in range(2):
             float(m.step(fz(chunks[0])).mse)  # completion-fetch warmup
 
@@ -116,8 +120,10 @@ def main(argv=None) -> None:
         arms[name] = one_pass
 
     pipeline_arm("padded_b2048", 2048, "padded")  # the r2 operating point
-    pipeline_arm("ragged_b2048", 2048, "ragged")
-    pipeline_arm("ragged_b1024", 1024, "ragged")
+    pipeline_arm("ragged_b2048", 2048, "ragged", int8=True)
+    pipeline_arm("ragged_b1024", 1024, "ragged", int8=True)  # int8 G plane
+    pipeline_arm("ragged_b1024_bf16", 1024, "ragged", int8=False)  # r3 plane A/B
+    pipeline_arm("ragged_b2048_bf16", 2048, "ragged", int8=False)
     pipeline_arm("ragged_b512", 512, "ragged")
     pipeline_arm("padded_b1024", 1024, "padded")
     superbatch_arm("padded_b2048_k8", 2048, 8)
@@ -141,6 +147,14 @@ def main(argv=None) -> None:
         if name != "padded_b2048":
             out[name]["paired_speedup_median"] = round(
                 statistics.median([b / t for b, t in zip(base, ts)]), 3
+            )
+    # the int8-plane question, answered directly: same wire, same batch,
+    # per-round ratios of the bf16-plane arm over the int8-plane arm
+    for b in (1024, 2048):
+        i8, bf = times.get(f"ragged_b{b}"), times.get(f"ragged_b{b}_bf16")
+        if i8 and bf:
+            out[f"int8_vs_bf16_b{b}"] = round(
+                statistics.median([x / y for x, y in zip(bf, i8)]), 3
             )
     print(json.dumps(out))
 

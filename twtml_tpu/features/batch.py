@@ -186,15 +186,28 @@ class RaggedUnitBatch:
 
     Fields: units [N] uint8|uint16 (narrow iff every row ASCII, as in
     UnitBatch), offsets [B+1] int32, numeric/label/mask as in UnitBatch.
+
+    ``num_shards`` > 1 marks a SHARD-ALIGNED buffer (``align_ragged_shards``):
+    the units are S equal sub-buffers of N/S units (shard s's rows
+    concatenated, zero-padded per sub-buffer) and the offsets are S
+    segment-RELATIVE [B/S + 1] blocks ([B + S] total) — every leaf's
+    leading dim is divisible by S, so the mesh data axis shards the ragged
+    wire like any padded batch and each device receives exactly its rows'
+    units with no cross-shard bytes. ``ops/ragged.ragged_repad`` rebuilds
+    identically in every layout. Static aux, like ``row_len``.
     """
 
-    def __init__(self, units, offsets, numeric, label, mask, row_len: int):
+    def __init__(
+        self, units, offsets, numeric, label, mask, row_len: int,
+        num_shards: int = 1,
+    ):
         self.units = units
         self.offsets = offsets
         self.numeric = numeric
         self.label = label
         self.mask = mask
         self.row_len = int(row_len)
+        self.num_shards = int(num_shards)
 
     @property
     def num_valid(self) -> int:
@@ -208,13 +221,68 @@ def _register_ragged():
         RaggedUnitBatch,
         lambda rb: (
             (rb.units, rb.offsets, rb.numeric, rb.label, rb.mask),
-            rb.row_len,
+            (rb.row_len, rb.num_shards),
         ),
-        lambda row_len, leaves: RaggedUnitBatch(*leaves, row_len=row_len),
+        lambda aux, leaves: RaggedUnitBatch(
+            *leaves, row_len=aux[0], num_shards=aux[1]
+        ),
     )
 
 
 _register_ragged()
+
+
+def align_ragged_shards(
+    rb: "RaggedUnitBatch", num_shards: int, unit_bucket: int = 0
+) -> "RaggedUnitBatch":
+    """Re-lay a ragged batch into ``num_shards`` equal shard segments so a
+    mesh data axis can shard it (see RaggedUnitBatch docstring). Host-side,
+    two memcpys of the units. ``unit_bucket`` pins the per-shard sub-buffer
+    capacity (multi-host runs agree it via the lockstep tick so every
+    process compiles the same program); 0 sizes it from this batch's
+    longest shard, rounded to RAGGED_UNIT_MULTIPLE."""
+    if rb.num_shards == num_shards:
+        if unit_bucket and rb.units.shape[0] != num_shards * unit_bucket:
+            raise ValueError(
+                f"batch is aligned to sub-buffers of "
+                f"{rb.units.shape[0] // num_shards} units, not the pinned "
+                f"bucket {unit_bucket}"
+            )
+        return rb
+    if rb.num_shards != 1:
+        raise ValueError("batch is already shard-aligned; re-align from flat")
+    b = rb.mask.shape[0]
+    if b % num_shards:
+        raise ValueError(
+            f"batch rows {b} not divisible by {num_shards} shards"
+        )
+    b_local = b // num_shards
+    offs = np.asarray(rb.offsets, np.int64)
+    starts = offs[0 : b + 1 : b_local]  # shard boundaries, [S+1]
+    seg_lens = starts[1:] - starts[:-1]
+    need = int(seg_lens.max()) if num_shards else 0
+    n_sb = max(
+        RAGGED_UNIT_MULTIPLE,
+        -(-need // RAGGED_UNIT_MULTIPLE) * RAGGED_UNIT_MULTIPLE,
+    )
+    if unit_bucket:
+        if need > unit_bucket:
+            raise ValueError(
+                f"shard units {need} exceed the pinned bucket {unit_bucket}"
+            )
+        n_sb = unit_bucket
+    units = np.asarray(rb.units)
+    flat = np.zeros((num_shards * n_sb,), units.dtype)
+    new_offs = np.empty((b + num_shards,), np.int32)
+    for s in range(num_shards):
+        lo, hi = int(starts[s]), int(starts[s + 1])
+        flat[s * n_sb : s * n_sb + (hi - lo)] = units[lo:hi]
+        blk = offs[s * b_local : (s + 1) * b_local + 1] - lo
+        new_offs[s * (b_local + 1) : (s + 1) * (b_local + 1)] = blk
+    return RaggedUnitBatch(
+        flat, new_offs, rb.numeric, rb.label, rb.mask,
+        row_len=rb.row_len, num_shards=num_shards,
+    )
 
 # the ragged units buffer rounds its total up to this multiple: waste is
 # bounded by RAGGED_UNIT_MULTIPLE units (≤8 KB uint16) per batch while the
@@ -254,7 +322,7 @@ def pack_batch(
             batch.units, batch.offsets, batch.numeric, batch.label,
             batch.mask,
         )
-        extra: "tuple | None" = (batch.row_len,)
+        extra: "tuple | None" = (batch.row_len, batch.num_shards)
     else:
         arrays = tuple(batch)
         extra = None
@@ -293,7 +361,12 @@ def unpack_batch(buffer, layout: tuple):
             arr = lax.bitcast_convert_type(chunk, dt).reshape(shape)
         fields.append(arr)
     if cls is RaggedUnitBatch:
-        return RaggedUnitBatch(*fields, row_len=layout[2][0])
+        extra = layout[2]
+        return RaggedUnitBatch(
+            *fields,
+            row_len=extra[0],
+            num_shards=extra[1] if len(extra) > 1 else 1,
+        )
     return cls(*fields)
 
 

@@ -49,6 +49,7 @@ from ..ops.gram import (
     gram_matrix,
     text_gram,
 )
+from ..ops.ragged import ragged_repad
 from ..ops.sparse import densify_text, sparse_grad_text, sparse_predict
 from ..ops.stats import batch_stats
 from ..ops.text_hash import hash_bigrams_device
@@ -235,6 +236,7 @@ def make_sgd_train_step(
     use_sparse: bool | None = None,
     round_predictions: bool = True,
     use_gram: bool | None = None,
+    gram_int8: bool | None = None,
 ):
     """Build the fused (weights, batch) → (new_weights, StepOutput) step.
 
@@ -262,6 +264,10 @@ def make_sgd_train_step(
     batch) in the scatter loop. ``use_gram`` False forces the scatter loop
     (the differential baseline); None picks Gram whenever it applies (f32
     weights, dense counts within HBM budget — ops/gram.py ``fits_gram``).
+    ``gram_int8`` pins the G build's int8 plane on/off at trace time
+    (None = the module default, ops/gram.py ``GRAM_INT8_PLANE``) — threaded
+    as a parameter, not a global read, so multi-shape callers (the ragged
+    wire retraces per flat-buffer bucket) get ONE consistent plane.
     """
     f_text = num_text_features
     sparse = f_text > DENSE_TEXT_FEATURE_LIMIT if use_sparse is None else use_sparse
@@ -311,12 +317,15 @@ def make_sgd_train_step(
                 f_text,
                 row_start=lax.axis_index(axis_name) * rows,
                 rows=rows,
+                int8_plane=gram_int8,
             )  # [B_local, B_global]: the G matmul's FLOPs scale 1/shards
             # (the count build replicates per shard — see text_gram.left)
             g_text = lax.all_gather(panel, axis_name, axis=0, tiled=True)
             g = add_numeric_block(g_text, numeric, dtype)
         else:
-            g = gram_matrix(token_idx, token_val, numeric, f_text, dtype)
+            g = gram_matrix(
+                token_idx, token_val, numeric, f_text, dtype, int8_plane=gram_int8
+            )
 
         dual = run_dual_loop(
             u=u,
@@ -363,20 +372,11 @@ def make_sgd_train_step(
             batch = unpack_batch(batch.buffer, batch.layout)
         if isinstance(batch, RaggedUnitBatch):
             # ragged wire: the units arrive concatenated (no per-row pad
-            # bytes on the transport); rebuild the padded [B, L] with ONE
-            # gather (cheap on TPU — scatters serialize, gathers don't) and
-            # case-fold ASCII here, which the padded wire's C pad copy did
-            # on the host — bit-identical units either way
-            offs = batch.offsets.astype(jnp.int32)
-            starts, lens = offs[:-1], offs[1:] - offs[:-1]
-            cols = jnp.arange(batch.row_len, dtype=jnp.int32)[None, :]
-            idx = jnp.clip(
-                starts[:, None] + cols, 0, batch.units.shape[0] - 1
+            # bytes on the transport); ops/ragged.py rebuilds the padded
+            # [B, L] + ASCII fold on device — bit-identical units either way
+            buf, lens = ragged_repad(
+                batch.units, batch.offsets, batch.row_len, batch.mask.shape[0]
             )
-            buf = jnp.where(
-                cols < lens[:, None], batch.units[idx].astype(jnp.int32), 0
-            )
-            buf = buf + ((buf >= 65) & (buf <= 90)) * 32  # ASCII fold
             batch = UnitBatch(
                 buf, lens, batch.numeric, batch.label, batch.mask
             )
@@ -493,6 +493,7 @@ class StreamingSGDModel:
         dtype=jnp.float32,
         use_sparse: bool | None = None,
         use_gram: bool | None = None,
+        gram_int8: bool | None = None,
     ) -> None:
         self.num_text_features = num_text_features
         self.dtype = dtype
@@ -509,6 +510,7 @@ class StreamingSGDModel:
             round_predictions=self.round_predictions,
             use_sparse=use_sparse,
             use_gram=use_gram,  # None=auto; False is the scatter-loop escape hatch
+            gram_int8=gram_int8,
         )
         # donate weights: the update happens in-place in HBM
         self._train_step = step

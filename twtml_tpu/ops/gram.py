@@ -49,14 +49,32 @@ matrix that doesn't round-trip through bf16 (a per-row-feature count above
 256 — beyond any real tweet) promotes the G matmul to
 ``Precision.HIGHEST``. G is therefore (near-)exact for every input the
 scatter path accepts, and fast for every input that can occur.
+
+A third, faster plane rides the same gate ladder: when every row's total
+absolute token mass is ≤ 127 (true for every real tweet — per-occurrence
+1.0 values, ≤ ~70 bigrams), every count is an integer in [−127, 127] and
+therefore EXACT in int8, so both matmuls run s8×s8→s32 on the MXU — ~2×
+bf16 peak on v5e, and the [B, F] count matrix is half the bytes. Integer
+accumulation makes this plane bit-exact (no rounding at all), strictly
+stronger than the bf16 plane it tightens.
 """
 
 from __future__ import annotations
+
+import os
 
 import jax.numpy as jnp
 from jax import lax
 
 from .sparse import densify_text
+
+# The int8 plane is on by default; the flag exists so benches can build the
+# bf16-only program for paired A/B comparison (trace-time capture: set it
+# before the model's first step). TWTML_GRAM_INT8=0 disables it process-wide.
+GRAM_INT8_PLANE = os.environ.get("TWTML_GRAM_INT8", "1").lower() not in (
+    "0",
+    "false",
+)
 
 # Above this dense-counts footprint (B·F·4 bytes) the Gram build would not
 # fit comfortably in HBM next to the program's other buffers; the learner
@@ -79,6 +97,18 @@ def fits_gram(batch_rows: int, f_text: int, num_iterations: int) -> bool:
     )
 
 
+def _split_feature_index(token_idx, f_text: int):
+    """The two-level split ``f = hi·k_lo + lo`` both one-hot count builders
+    share — ONE definition so the planes cannot drift on feature layout."""
+    lo_bits = (max(f_text - 1, 1).bit_length() + 1) // 2
+    k_lo = 1 << lo_bits
+    k_hi = -(-f_text // k_lo)
+    return token_idx // k_lo, token_idx % k_lo, k_hi, k_lo
+
+
+_ONEHOT_DIMS = (((1,), (1,)), ((0,), (0,)))  # contract over l, batch over b
+
+
 def onehot_counts(token_idx, token_val, f_text: int, dtype=jnp.bfloat16):
     """[B, L] (idx, val) pairs → dense [B, F] ``dtype`` counts with NO
     scatter: the two-level one-hot batched matmul of the module docstring.
@@ -86,11 +116,7 @@ def onehot_counts(token_idx, token_val, f_text: int, dtype=jnp.bfloat16):
     the matmul epilogue, so the bf16 default halves the write (and the
     downstream G matmul's read) vs an f32 count matrix."""
     b, l = token_idx.shape
-    lo_bits = (max(f_text - 1, 1).bit_length() + 1) // 2
-    k_lo = 1 << lo_bits
-    k_hi = -(-f_text // k_lo)
-    hi = token_idx // k_lo
-    lo = token_idx % k_lo
+    hi, lo, k_hi, k_lo = _split_feature_index(token_idx, f_text)
     oh_hi = (hi[:, :, None] == jnp.arange(k_hi, dtype=hi.dtype)).astype(
         jnp.bfloat16
     ) * token_val[:, :, None].astype(jnp.bfloat16)
@@ -98,37 +124,77 @@ def onehot_counts(token_idx, token_val, f_text: int, dtype=jnp.bfloat16):
     c = lax.dot_general(
         oh_hi,
         oh_lo,
-        (((1,), (1,)), ((0,), (0,))),  # contract over l, batch over b
+        _ONEHOT_DIMS,
         preferred_element_type=jnp.float32,
     ).astype(dtype)  # [B, k_hi, k_lo]
     return c.reshape(b, k_hi * k_lo)[:, :f_text]
 
 
-def text_gram(token_idx, token_val, f_text: int, row_start=None, rows: int = 0):
+def onehot_counts_int8(token_idx, token_val, f_text: int):
+    """The int8 twin of ``onehot_counts``: [B, L] (idx, val) pairs → dense
+    [B, F] int8 counts via the same two-level one-hot batched matmul, with
+    s8 operands and s32 accumulation — integer-exact whenever the caller's
+    gate holds (integral values, per-row absolute mass ≤ 127, so every
+    count and every partial sum is an integer within range)."""
+    b, l = token_idx.shape
+    hi, lo, k_hi, k_lo = _split_feature_index(token_idx, f_text)
+    val_i8 = token_val.astype(jnp.int8)
+    oh_hi = jnp.where(
+        hi[:, :, None] == jnp.arange(k_hi, dtype=hi.dtype),
+        val_i8[:, :, None],
+        jnp.int8(0),
+    )
+    oh_lo = (lo[:, :, None] == jnp.arange(k_lo, dtype=lo.dtype)).astype(jnp.int8)
+    c = lax.dot_general(
+        oh_hi,
+        oh_lo,
+        _ONEHOT_DIMS,
+        preferred_element_type=jnp.int32,
+    ).astype(jnp.int8)  # counts ≤ row mass ≤ 127: the narrowing is exact
+    return c.reshape(b, k_hi * k_lo)[:, :f_text]
+
+
+def text_gram(
+    token_idx,
+    token_val,
+    f_text: int,
+    row_start=None,
+    rows: int = 0,
+    int8_plane: bool | None = None,
+):
     """Text-feature Gram block: X·Xᵀ ([B,B] f32), or the row slice
     ``X[row_start:row_start+rows]·Xᵀ`` ([rows, B]) when ``rows`` > 0 — the
     building block sharded layouts use (each shard computes its row panel
     and/or its feature slice's partial G, then all-gathers/psums).
 
     Common path (every real tweet): token values are small integers and each
-    row's total token mass is ≤ 255, which PROVES every count is an integer
-    ≤ 255 and therefore bf16-exact — so the count matrix is built by the
-    one-hot matmul straight into bf16 and the product is one bf16×bf16→f32
-    MXU matmul. The predicate costs one pass over the [B, L] token values
-    (not the [B, F] counts). Anything else — fractional values, a degenerate
-    row with > 255 mass — takes the exact fallback: f32 scatter densify +
-    full-f32 (``Precision.HIGHEST``) matmul.
+    row's total absolute mass is ≤ 127, which PROVES every count is an
+    integer in [−127, 127] and therefore int8-exact — so the count matrix is
+    built by the one-hot matmul straight into int8 and the product is one
+    s8×s8→s32 MXU matmul (~2× bf16 peak on v5e, half the count-matrix
+    bytes), bit-exact. Row mass in (127, 255] keeps the bf16 plane (counts
+    ≤ 255 are bf16-exact). The predicates cost one pass over the [B, L]
+    token values (not the [B, F] counts). Anything else — fractional values,
+    a degenerate row with > 255 mass — takes the exact fallback: f32 scatter
+    densify + full-f32 (``Precision.HIGHEST``) matmul.
     """
+    if int8_plane is None:
+        int8_plane = GRAM_INT8_PLANE
     val_f = token_val.astype(jnp.float32)
     # integral, bf16-representable values with row ABSOLUTE mass ≤ 255 ⇒
     # every count is an integer of magnitude ≤ 255 ⇒ counts and their bf16
     # products are exact (plain sum would be unsound for mixed-sign values:
     # cancellation can hide a per-feature count above the bf16 range)
+    integral = jnp.all(val_f == jnp.round(val_f))
+    row_mass = jnp.sum(jnp.abs(val_f), axis=1)
     vals_ok = (
-        jnp.all(val_f == jnp.round(val_f))
+        integral
         & jnp.all(val_f.astype(jnp.bfloat16).astype(jnp.float32) == val_f)
-        & jnp.all(jnp.sum(jnp.abs(val_f), axis=1) <= 255.0)
+        & jnp.all(row_mass <= 255.0)
     )
+    # row absolute mass ≤ 127 tightens every bound to the int8 range: each
+    # |value| ≤ 127 (s8 operand) and each |count| ≤ 127 (s8 count matrix)
+    vals_ok_i8 = integral & jnp.all(row_mass <= 127.0)
 
     def left(c):
         """The (possibly row-sliced) left operand. The slice makes the G
@@ -141,6 +207,12 @@ def text_gram(token_idx, token_val, f_text: int, row_start=None, rows: int = 0):
             return lax.dynamic_slice_in_dim(c, row_start, rows, axis=0)
         return c
 
+    def fast_i8(i, v):
+        c = onehot_counts_int8(i, v, f_text)  # [B, F] int8, exact
+        g = jnp.matmul(left(c), c.T, preferred_element_type=jnp.int32)
+        # |G| ≤ (Σ|c_a|)·max|c_b| ≤ 127² < 2²⁴: the f32 cast is exact
+        return g.astype(jnp.float32)
+
     def fast(i, v):
         c = onehot_counts(i, v, f_text)  # [B, F] bf16, exact
         return jnp.matmul(left(c), c.T, preferred_element_type=jnp.float32)
@@ -149,7 +221,12 @@ def text_gram(token_idx, token_val, f_text: int, row_start=None, rows: int = 0):
         c = densify_text(i, v, f_text)  # [B, F] f32
         return jnp.matmul(left(c), c.T, precision=lax.Precision.HIGHEST)
 
-    return lax.cond(vals_ok, fast, exact, token_idx, val_f)
+    idx = vals_ok.astype(jnp.int32)
+    branches = [exact, fast]
+    if int8_plane:
+        idx = idx + vals_ok_i8.astype(jnp.int32)  # i8-ok ⊆ bf16-ok: 0/1/2
+        branches.append(fast_i8)
+    return lax.switch(idx, branches, token_idx, val_f)
 
 
 def add_numeric_block(g_text, numeric, dtype=jnp.float32):
@@ -160,9 +237,20 @@ def add_numeric_block(g_text, numeric, dtype=jnp.float32):
     return (g_text + num @ num.T).astype(dtype)
 
 
-def gram_matrix(token_idx, token_val, numeric, f_text: int, dtype=jnp.float32):
+def gram_matrix(
+    token_idx,
+    token_val,
+    numeric,
+    f_text: int,
+    dtype=jnp.float32,
+    int8_plane: bool | None = None,
+):
     """G = Z·Zᵀ ([B,B] ``dtype``) for Z = [text counts | numeric features]."""
-    return add_numeric_block(text_gram(token_idx, token_val, f_text), numeric, dtype)
+    return add_numeric_block(
+        text_gram(token_idx, token_val, f_text, int8_plane=int8_plane),
+        numeric,
+        dtype,
+    )
 
 
 def dual_norm_sq(p_prev, u, g):

@@ -1,0 +1,53 @@
+"""Device-side re-pad of the ragged units wire — ONE definition shared by
+every step builder (single-device, data-parallel, feature-sharded), so the
+wire semantics cannot drift between layouts.
+
+The ragged wire (features/batch.py ``RaggedUnitBatch``) ships text as
+concatenated code units + row offsets — no per-row pad bytes on the
+upload-bound transport. The learner rebuilds the padded [B, L] layout
+INSIDE the jit program with one gather (cheap on TPU — it is scatters that
+serialize, not gathers) and case-folds ASCII there, which the padded wire's
+C pad copy did on the host. Features are bit-identical either way
+(tests/test_ragged_wire.py).
+
+Under shard_map the arrays arrive SHARD-LOCAL (this shard's sub-buffer and
+its shard-relative offsets — features/batch.py ``align_ragged_shards``),
+and the same gather rebuilds this shard's [B_local, L] rows; ``row_len``
+(L) is static and global, so every shard's re-pad agrees with the
+single-device layout.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def ragged_repad(units, offsets, row_len: int, rows: int | None = None):
+    """(flat units [N], offsets, static L) → (padded int32 [B, L]
+    case-folded units, int32 [B] lengths) — the padded-wire layout, on
+    device.
+
+    ``rows`` (B, the row count the caller's mask carries) tells the shard
+    count apart statically: a shard-ALIGNED buffer carries one
+    [B_s + 1] offsets block per segment, so S = offsets.size − rows
+    (S = 1 when offsets is the plain [B + 1] vector; None means plain).
+    Segment s's sub-buffer starts at s·(N/S) and its offsets are
+    segment-relative, so converting to absolute starts is one broadcast
+    add — the gather itself is identical in every layout."""
+    offs = offsets.astype(jnp.int32)
+    n_segments = 1 if rows is None else offsets.shape[0] - rows
+    if n_segments > 1:
+        ob = offs.reshape(n_segments, -1)  # [S, B_s + 1], segment-relative
+        base = (
+            jnp.arange(n_segments, dtype=jnp.int32)
+            * (units.shape[0] // n_segments)
+        )[:, None]
+        starts = (ob[:, :-1] + base).reshape(-1)
+        lens = (ob[:, 1:] - ob[:, :-1]).reshape(-1)
+    else:
+        starts, lens = offs[:-1], offs[1:] - offs[:-1]
+    cols = jnp.arange(row_len, dtype=jnp.int32)[None, :]
+    idx = jnp.clip(starts[:, None] + cols, 0, units.shape[0] - 1)
+    buf = jnp.where(cols < lens[:, None], units[idx].astype(jnp.int32), 0)
+    buf = buf + ((buf >= 65) & (buf <= 90)) * 32  # ASCII case fold
+    return buf, lens

@@ -30,7 +30,13 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..features.batch import NUM_NUMBER_FEATURES, FeatureBatch, UnitBatch
+from ..features.batch import (
+    NUM_NUMBER_FEATURES,
+    FeatureBatch,
+    RaggedUnitBatch,
+    UnitBatch,
+    align_ragged_shards,
+)
 from ..models.base import StepOutput
 from ..models.sgd import (
     dual_scale_and_alpha,
@@ -40,6 +46,7 @@ from ..models.sgd import (
     sgd_inner_loop,
 )
 from ..ops.gram import add_numeric_block, fits_gram, text_gram
+from ..ops.ragged import ragged_repad
 from ..ops.sparse import sparse_grad_text, sparse_text_dot
 from ..ops.stats import batch_stats
 from ..ops.text_hash import hash_bigrams_device
@@ -70,6 +77,11 @@ def unit_batch_pspecs(data_axis: str = "data") -> UnitBatch:
 
 
 def _pspecs_for(batch_cls, data_axis: str):
+    if batch_cls is RaggedUnitBatch:
+        # one P(data) prefix-spec: every ragged leaf (units sub-buffers,
+        # segment-relative offsets, rows) shards its leading dim — the
+        # shard-aligned layout makes them all divisible by the data axis
+        return P(data_axis)
     return (
         unit_batch_pspecs(data_axis)
         if batch_cls is UnitBatch
@@ -87,12 +99,29 @@ def _stacked(spec_tree):
     )
 
 
-def shard_batch(batch: FeatureBatch | UnitBatch, mesh):
+def shard_batch(batch: FeatureBatch | UnitBatch | RaggedUnitBatch, mesh):
     """Place a host batch onto the mesh with row sharding (explicit
     device_put so repeated steps don't re-infer layouts). Stacked
     superbatches ([K, ...] leaves — detected by the mask rank) shard their
-    row axis the same way with K unsharded."""
-    specs = _pspecs_for(type(batch), mesh.axis_names[0])
+    row axis the same way with K unsharded. A RaggedUnitBatch is
+    shard-ALIGNED first (``align_ragged_shards`` — a host memcpy unless the
+    featurizer already aligned it), after which every leaf row-shards over
+    ``data`` like the padded wire."""
+    data_axis = mesh.axis_names[0]
+    if isinstance(batch, RaggedUnitBatch):
+        num_data = mesh.shape[data_axis]
+        if batch.num_shards != num_data:
+            batch = align_ragged_shards(batch, num_data)
+        sharding = NamedSharding(mesh, P(data_axis))
+        return RaggedUnitBatch(
+            *(jax.device_put(a, sharding) for a in (
+                batch.units, batch.offsets, batch.numeric, batch.label,
+                batch.mask,
+            )),
+            row_len=batch.row_len,
+            num_shards=batch.num_shards,
+        )
+    specs = _pspecs_for(type(batch), data_axis)
     if batch.mask.ndim == 2:  # stacked: [K, B] mask
         specs = _stacked(specs)
     return type(batch)(*(
@@ -116,6 +145,7 @@ def _make_feature_sharded_step(
     data_axis: str,
     model_axis: str,
     use_gram: bool | None = None,
+    gram_int8: bool | None = None,
 ):
     """Per-shard body for the 2D (data × model) mesh. Weights arrive as a
     {'text': [f_text_local], 'num': [4]} pytree; token indices are global and
@@ -134,9 +164,17 @@ def _make_feature_sharded_step(
     residual_fn = residual_fn or (lambda raw, label: raw - label)
     prediction_fn = prediction_fn or (lambda raw: raw)
 
-    def step(weights, batch: FeatureBatch | UnitBatch):
+    def step(weights, batch: FeatureBatch | UnitBatch | RaggedUnitBatch):
         w_text, w_num = weights["text"], weights["num"]
         dtype = w_text.dtype
+        if isinstance(batch, RaggedUnitBatch):
+            # ragged wire, shard-local arrays: re-pad + fold on device
+            # (ops/ragged.py), then hash like the padded units wire below
+            buf, lens = ragged_repad(
+                batch.units, batch.offsets, batch.row_len,
+                batch.mask.shape[0],
+            )
+            batch = UnitBatch(buf, lens, batch.numeric, batch.label, batch.mask)
         mask = batch.mask.astype(dtype)
         labels = batch.label.astype(dtype)
         if isinstance(batch, UnitBatch):
@@ -189,6 +227,7 @@ def _make_feature_sharded_step(
                 f_text_local,
                 row_start=lax.axis_index(data_axis) * b_local,
                 rows=b_local,
+                int8_plane=gram_int8,
             )  # [B_local, B_global] partial over this feature slice
             g_mat = lax.all_gather(
                 lax.psum(panel, model_axis), data_axis, axis=0, tiled=True
@@ -276,6 +315,7 @@ class ParallelSGDModel:
         round_predictions: bool = True,
         use_sparse: bool | None = None,
         use_gram: bool | None = None,
+        gram_int8: bool | None = None,
     ) -> None:
         self.mesh = mesh
         self.num_text_features = num_text_features
@@ -301,6 +341,7 @@ class ParallelSGDModel:
                 axis_name=self.data_axis,
                 use_sparse=use_sparse,
                 use_gram=use_gram,
+                gram_int8=gram_int8,
             )
             self._weights = jnp.zeros(
                 (num_text_features + NUM_NUMBER_FEATURES,), dtype
@@ -327,6 +368,7 @@ class ParallelSGDModel:
                 data_axis=self.data_axis,
                 model_axis=self.model_axis,
                 use_gram=use_gram,
+                gram_int8=gram_int8,
             )
             self._weights = {
                 "text": jax.device_put(
