@@ -1,0 +1,156 @@
+"""Implementation-independent literals for the MLlib GradientDescent
+semantics (VERDICT r3 #6).
+
+The differential oracle in tests/test_sgd_models.py is independent CODE, but
+code beside the implementation can share a misreading of the spec. These
+tests pin the parity-critical update rule — stepSize/√i decay (1-indexed),
+SquaredL2Updater pre-scale, zero-sample skip, convergence freeze
+(GradientDescent.runMiniBatchSGD, SURVEY.md §3.3) — to HAND-COMPUTED
+trajectories: tiny integer batches, every iteration's arithmetic written
+out in the comments, expected weights as decimal literals. Each literal is
+checked against all three formulations of the loop (dense matmul, sparse
+gather/scatter, Gram dual — models/sgd.py, ops/gram.py): a bug shared by
+an oracle and the implementation cannot survive a hand-derived constant.
+
+Batch layout: x rows are unit vectors over 2 text features; the 4 numeric
+features are zero except where a test says otherwise; padded token slots
+carry (idx=0, val=0) per the batch contract.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from twtml_tpu.features.batch import NUM_NUMBER_FEATURES, FeatureBatch
+from twtml_tpu.models.sgd import make_sgd_train_step
+
+F_TEXT = 2
+DIM = F_TEXT + NUM_NUMBER_FEATURES
+
+# e0/e1 rows: row i has a single token occurrence of feature i (val 1.0),
+# second slot padded
+TOKEN_IDX = np.array([[0, 0], [1, 0]], np.int32)
+TOKEN_VAL = np.array([[1.0, 0.0], [1.0, 0.0]], np.float32)
+
+
+def two_row_batch(labels, mask=(1.0, 1.0)):
+    return FeatureBatch(
+        TOKEN_IDX,
+        TOKEN_VAL,
+        np.zeros((2, NUM_NUMBER_FEATURES), np.float32),
+        np.asarray(labels, np.float32),
+        np.asarray(mask, np.float32),
+    )
+
+
+def all_formulations(**kw):
+    """The same semantics through every loop formulation in the framework."""
+    kw.setdefault("num_text_features", F_TEXT)
+    kw.setdefault("mini_batch_fraction", 1.0)
+    kw.setdefault("convergence_tol", 0.0)
+    return {
+        "dense": make_sgd_train_step(use_sparse=False, **kw),
+        "scatter": make_sgd_train_step(use_sparse=True, use_gram=False, **kw),
+        "gram": make_sgd_train_step(use_sparse=True, use_gram=True, **kw),
+    }
+
+
+def assert_all_hit(steps, w0, batch, expected, rtol=1e-6, atol=1e-6):
+    for name, step in steps.items():
+        w1, _ = step(jnp.asarray(w0, jnp.float32), batch)
+        np.testing.assert_allclose(
+            np.asarray(w1), expected, rtol=rtol, atol=atol,
+            err_msg=f"formulation {name!r} missed the hand-computed literal",
+        )
+
+
+def test_sqrt_decay_two_iterations_literal():
+    """stepSize/√i, 1-indexed, from w0 = 0; labels y = (2, 4), stepSize 1.
+
+    it=1: η = 1/√1 = 1. raw = (0, 0); residuals r = (0−2, 0−4) = (−2, −4).
+          grad_sum = (−2, −4); count = 2 ⇒ grad/denom = (−1, −2).
+          w = 0 − 1·(−1, −2) = (1, 2).
+    it=2: η = 1/√2. raw = (1, 2); r = (−1, −2); grad/denom = (−1/2, −1).
+          w = (1 + 1/(2√2), 2 + 1/√2).
+    Literals: 1 + 1/(2√2) = 1.3535533905932737…, 2 + 1/√2 = 2.7071067811865475…
+    (a 1-indexing bug would give η = 1/√2, 1/√3 → (1.1153.., 2.2306..)·2 — far
+    outside tolerance; a 0-indexed-η=∞ bug would NaN).
+    """
+    steps = all_formulations(num_iterations=2, step_size=1.0)
+    expected = np.array(
+        [1.3535533905932737, 2.7071067811865475, 0, 0, 0, 0], np.float64
+    )
+    assert_all_hit(steps, np.zeros(DIM), two_row_batch((2.0, 4.0)), expected)
+
+
+def test_l2_pre_scale_one_iteration_literal():
+    """SquaredL2Updater: w ← w·(1 − η·λ) − η·g/n, λ = 0.5, stepSize 1,
+    w0 = ones (INCLUDING the numeric weights the batch never touches).
+
+    it=1: η = 1. raw = (1, 1); y = (2, 4) ⇒ r = (−1, −3); grad/denom =
+          (−1/2, −3/2) on the two text dims, 0 on the numeric dims.
+          text:    w = 1·(1 − 0.5) + (0.5, 1.5) = (1.0, 2.0)
+          numeric: w = 1·(1 − 0.5) − 0       = 0.5   ← the pre-scale hits
+          untouched weights too (the lazy-c dual path must match this).
+    """
+    steps = all_formulations(num_iterations=1, step_size=1.0, l2_reg=0.5)
+    expected = np.array([1.0, 2.0, 0.5, 0.5, 0.5, 0.5], np.float64)
+    assert_all_hit(steps, np.ones(DIM), two_row_batch((2.0, 4.0)), expected)
+
+
+def test_l2_stationary_point_two_iterations_literal():
+    """At w = (1, 2) with y = (2, 4), λ = 0.5: residuals r = (−1, −2), so
+    grad/denom = (−1/2, −1) = −λ·w exactly — the L2-regularized stationary
+    point (∇½mse + λw = 0). A second iteration at any η must leave the
+    touched weights EXACTLY fixed while the untouched numeric weights keep
+    shrinking by (1 − η·λ):
+
+    it=2: η = 1/√2.  text:    w = w·(1 − η/2) + η·(1/2, 1) = (1, 2)  (exact)
+                     numeric: w = 0.5·(1 − 1/(2√2)) = 0.32322330470336313
+    """
+    steps = all_formulations(num_iterations=2, step_size=1.0, l2_reg=0.5)
+    expected = np.array(
+        [1.0, 2.0] + [0.32322330470336313] * 4, np.float64
+    )
+    assert_all_hit(steps, np.ones(DIM), two_row_batch((2.0, 4.0)), expected)
+
+
+def test_zero_sample_iteration_skips_literal():
+    """MLlib: an iteration that samples zero points leaves weights UNCHANGED
+    — no L2 shrink, no NaN from the 0-count denominator. With every row
+    masked out, all 3 iterations must be exact no-ops on a nonzero w0
+    (λ = 0.5 would shrink w if the skip were broken)."""
+    steps = all_formulations(num_iterations=3, step_size=1.0, l2_reg=0.5)
+    w0 = np.array([1.0, -2.0, 3.0, 4.0, 0.25, -0.5])
+    assert_all_hit(
+        steps, w0, two_row_batch((2.0, 4.0), mask=(0.0, 0.0)), w0, rtol=0, atol=0
+    )
+
+
+def test_convergence_freeze_literal():
+    """Convergence test ‖w_i − w_{i−1}‖ < tol·max(‖w_i‖, 1), then FREEZE.
+    One row (x = e0, y = 2), stepSize 0.5, tol 0.4, 3 iterations, w0 = 0:
+
+    it=1: η = 0.5. r = −2 ⇒ w = (1). Δ = 1, ‖w‖ = 1: 1 < 0.4? no.
+    it=2: η = 0.5/√2. r = 1 − 2 = −1 ⇒ w = 1 + 0.5/√2 = 1.3535533905932737.
+          Δ = 0.3535533…, tol·‖w‖ = 0.4·1.3535533… = 0.5414213…: CONVERGED
+          (the it=2 update is still applied; freeze starts NEXT iteration).
+    it=3: frozen — w stays 1.3535533905932737. Without the freeze it would
+          move to w + (0.5/√3)·(2 − w) = 1.5401664525721208… (checked ≠).
+    """
+    frozen = all_formulations(
+        num_iterations=3, step_size=0.5, convergence_tol=0.4
+    )
+    batch = FeatureBatch(
+        np.array([[0, 0]], np.int32),
+        np.array([[1.0, 0.0]], np.float32),
+        np.zeros((1, NUM_NUMBER_FEATURES), np.float32),
+        np.array([2.0], np.float32),
+        np.array([1.0], np.float32),
+    )
+    expected = np.array([1.3535533905932737, 0, 0, 0, 0, 0], np.float64)
+    assert_all_hit(frozen, np.zeros(DIM), batch, expected)
+    # and the freeze is what held it there: tol=0 runs through to it=3
+    free = all_formulations(num_iterations=3, step_size=0.5)
+    unfrozen = np.array([1.5401664525721208, 0, 0, 0, 0, 0], np.float64)
+    assert_all_hit(free, np.zeros(DIM), batch, unfrozen, rtol=1e-5, atol=1e-6)
