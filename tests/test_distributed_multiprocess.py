@@ -211,6 +211,82 @@ def test_app_level_multihost_cli_trains_in_lockstep(tmp_path):
     assert meta_m2["count"] == 400
 
 
+def test_app_level_multihost_ragged_wire(tmp_path):
+    """r4 (VERDICT r3 #2): the RAGGED wire through the real multi-host CLI —
+    each host re-lays its rows into shard-aligned segments with the
+    per-shard bucket agreed by allgather (parallel/distributed.py), and the
+    run matches a single-process MESH run of the same app with the same
+    wire (which itself bit-matches the padded wire,
+    tests/test_ragged_sharded.py)."""
+    import json as _json
+    import re
+
+    from tools.bench_suite import _status_json
+    from twtml_tpu.streaming.sources import SyntheticSource
+
+    path = tmp_path / "tweets.jsonl"
+    statuses = list(
+        SyntheticSource(total=128, seed=9, base_ms=1785320000000).produce()
+    )
+    with open(path, "w") as fh:
+        for s in statuses:
+            fh.write(_json.dumps(_status_json(s)) + "\n")
+
+    closed = "http://127.0.0.1:9"
+    common = [
+        "linear", "--source", "replay", "--replayFile", str(path),
+        "--seconds", "0", "--backend", "cpu", "--tokenBucket", "64",
+        "--wire", "ragged", "--hashOn", "device",
+        "--lightning", closed, "--twtweb", closed,
+    ]
+    d_single, d_multi = str(tmp_path / "ck1"), str(tmp_path / "ck2")
+    single = _run_app_group(
+        common + ["--batchBucket", "32", "--checkpointDir", d_single],
+        nprocs=1, ndev=4,
+    )
+    multi = _run_app_group(
+        common + ["--batchBucket", "16", "--checkpointDir", d_multi],
+        nprocs=2, ndev=2,
+    )
+
+    def stat_lines(out):
+        return [ln for ln in out.splitlines() if ln.startswith("count:")]
+
+    lead, follower = stat_lines(multi[0]), stat_lines(multi[1])
+    ref = stat_lines(single[0])
+    assert follower == []  # one telemetry owner per run
+    assert len(lead) == len(ref) >= 3
+
+    for got, want in zip(lead, ref):
+        g = [int(x) for x in re.findall(r"-?\d+", got)]
+        w = [int(x) for x in re.findall(r"-?\d+", want)]
+        assert g[:2] == w[:2]  # cumulative count and batch size: exact
+        for a, b in zip(g[2:], w[2:]):  # mse/stdevs: rounded ints, FP order
+            assert abs(a - b) <= 2, (got, want)
+
+    from twtml_tpu.checkpoint import Checkpointer
+
+    w_single, meta_s = Checkpointer(d_single).restore()
+    w_multi, meta_m = Checkpointer(d_multi).restore()
+    assert meta_s["count"] == meta_m["count"] == 128
+    np.testing.assert_allclose(w_multi, w_single, rtol=1e-4, atol=1e-7)
+
+    # the one-data-shard-per-process topology (local_shards == 1): a flat
+    # batch is trivially "aligned" and hosts' buffers can differ — the
+    # agreed bucket must grow the smaller host, never raise (r4 review)
+    d_one = str(tmp_path / "ck3")
+    one = _run_app_group(
+        common + ["--batchBucket", "16", "--checkpointDir", d_one],
+        nprocs=2, ndev=1,
+    )
+    lead1 = stat_lines(one[0])
+    assert stat_lines(one[1]) == []
+    assert len(lead1) == len(ref)
+    w_one, meta_o = Checkpointer(d_one).restore()
+    assert meta_o["count"] == 128
+    np.testing.assert_allclose(w_one, w_single, rtol=1e-4, atol=1e-7)
+
+
 def test_app_level_multihost_kmeans_lockstep(tmp_path):
     """The k-means entry through the multi-host CLI: per-host sharded
     intake, GLOBAL per-batch StandardScaler, mesh psums spanning hosts —

@@ -160,3 +160,50 @@ def test_cap_reached_still_delivers_pending_handles():
     pipe.on_batch(2, 0.0)  # beyond the cap: not trained, but 0 and 1 deliver
     assert model.dispatched == [0, 1]
     assert events == [0, 1]
+
+
+def test_refund_does_not_perturb_checkpoint_cadence():
+    """r3 advisor: cadence runs on a MONOTONIC counter — a refunded
+    dispatch slot (multi-host empty-global batches) must not make the
+    cadence pass a point twice or skip it."""
+    model, events = FakeModel(), []
+    pipe = FetchPipeline(
+        model,
+        lambda out, b, t, at_boundary: events.append((int(out["i"]), at_boundary)),
+        depth=4, boundary_every=3, max_dispatch=50,
+    )
+    for i in range(9):
+        pipe.on_batch(i, 0.0)
+        pipe.refund_dispatch()  # every batch refunds (worst case)
+    pipe.flush()
+    boundaries = [i for i, at_b in events if at_b]
+    # cadence unchanged by the refunds: every 3rd batch still drains
+    assert set(boundaries) >= {2, 5, 8}
+    # and the refunds did their own job: the cap accounting went negative-
+    # of-dispatch (50-cap never reached, all 9 trained)
+    assert [e[0] for e in events] == list(range(9))
+
+
+def test_deterministic_mode_emits_only_at_deterministic_points():
+    """r3 advisor (multi-host): with deterministic=True the opportunistic
+    already-done early emit is disabled — deliveries happen only at depth
+    backpressure, cadence drains, and flush, i.e. at points driven by the
+    dispatch counter (identical on every lockstep host), never by
+    wall-clock future completion."""
+    import time as _time
+
+    model, events = FakeModel(), []
+    pipe = FetchPipeline(
+        model,
+        lambda out, b, t, at_boundary: events.append(int(out["i"])),
+        depth=4, deterministic=True,
+    )
+    for i in range(4):
+        pipe.on_batch(i, 0.0)
+        _time.sleep(0.02)  # futures certainly done (instant fake model)...
+        # ...yet nothing may emit below the depth watermark
+        assert events == []
+    pipe.on_batch(4, 0.0)  # 5th dispatch finds depth reached → one emit
+    assert events == [0]
+    pipe.flush()
+    assert events == [0, 1, 2, 3, 4]

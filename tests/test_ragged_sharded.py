@@ -88,6 +88,28 @@ def test_pinned_unit_bucket_shapes():
     np.testing.assert_array_equal(np.asarray(a_buf), np.asarray(f_buf))
 
 
+def test_prealigned_batch_grows_to_pinned_bucket():
+    """The one-data-shard-per-process topology: a FLAT batch is trivially
+    aligned to 1 shard, and the multi-host agreed bucket can exceed this
+    host's buffer — align must PAD UP (tail zeros; segment-relative
+    offsets untouched), not raise (r4 review finding)."""
+    from twtml_tpu.ops.ragged import ragged_repad
+
+    rb = ragged_chunks(synthetic(n=32))[0]
+    assert rb.num_shards == 1 and rb.units.shape[0] == RAGGED_UNIT_MULTIPLE
+    grown = align_ragged_shards(rb, 1, unit_bucket=2 * RAGGED_UNIT_MULTIPLE)
+    assert grown.units.shape == (2 * RAGGED_UNIT_MULTIPLE,)
+    np.testing.assert_array_equal(grown.offsets, rb.offsets)
+    g_buf, _ = ragged_repad(
+        grown.units, grown.offsets, grown.row_len, grown.mask.shape[0]
+    )
+    f_buf, _ = ragged_repad(rb.units, rb.offsets, rb.row_len, rb.mask.shape[0])
+    np.testing.assert_array_equal(np.asarray(g_buf), np.asarray(f_buf))
+    # shrinking below the current buffer is still an error
+    with pytest.raises(ValueError, match="cannot\n?\\s*shrink"):
+        align_ragged_shards(grown, 1, unit_bucket=RAGGED_UNIT_MULTIPLE)
+
+
 def test_aligned_batch_single_device_matches_flat():
     """An aligned batch stepped WITHOUT a mesh (num_shards > 1, no axis)
     must train identically to the flat ragged batch — the segment-aware

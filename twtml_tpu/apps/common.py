@@ -118,11 +118,6 @@ def build_source(
                 "--wire ragged is a device-hash wire format; "
                 "it requires --hashOn device"
             )
-        if multihost:
-            raise SystemExit(
-                "--wire ragged is single-device (a ragged buffer has no "
-                "row sharding); use --wire padded for multi-host runs"
-            )
     if conf.ingest == "block" and not allow_block:
         raise SystemExit(
             "--ingest block is not wired for this entry point; "
@@ -237,12 +232,6 @@ def build_model(conf, model_cls=StreamingLinearRegressionWithSGD):
     the sharded step). Returns (model, required row multiple for batches)."""
     mesh = build_mesh(conf, what=f"training ({model_cls.__name__})")
     if mesh is not None:
-        if getattr(conf, "wire", "padded") == "ragged":
-            raise SystemExit(
-                "--wire ragged is single-device (a ragged buffer has no row "
-                "sharding); use --wire padded on a mesh, or --master "
-                "local[1]"
-            )
         from ..parallel import ParallelSGDModel
 
         model = ParallelSGDModel.from_conf(
@@ -516,11 +505,21 @@ class FetchPipeline:
     like the superbatch's group boundaries); ``max_dispatch`` caps how
     many batches may train, so max-batches stops stay EXACT (the cap is
     enforced before dispatch, not discovered after). ``flush()`` after
-    stream termination drains the tail."""
+    stream termination drains the tail.
+
+    ``deterministic`` (multi-host mode) disables the opportunistic
+    already-done early emit: handler side effects (request_stop,
+    empty-global refunds) then fire only at DETERMINISTIC points — the
+    depth backpressure, cadence drains, cap drains, and flush — all driven
+    by the dispatch counter, which advances identically on every lockstep
+    host. With the opportunistic emit, one host could see a stop/refund a
+    tick earlier than a peer (wall-clock-dependent ``done()``), exit the
+    lockstep loop early, and leave the peer blocked in its next
+    collective (r3 advisor finding)."""
 
     def __init__(self, model, handle, depth: int = 8, stop_requested=None,
                  boundary_every: int = 0, max_dispatch: int = 0,
-                 pack: bool = False):
+                 pack: bool = False, deterministic: bool = False):
         from concurrent.futures import ThreadPoolExecutor
 
         self.model = model
@@ -531,14 +530,24 @@ class FetchPipeline:
         # request overhead stops hiding once the wire is lean); handlers
         # still receive the UNPACKED batch
         self.pack = pack
+        self.deterministic = deterministic
         self._stop_requested = stop_requested
         self.boundary_every = boundary_every
         self.max_dispatch = max_dispatch
+        # model-aware host transfer (MultiHostSGDModel.fetch_output defers
+        # the lead's prediction localization into the pooled fetch); plain
+        # models use jax.device_get
+        self._fetch = getattr(model, "fetch_output", None)
         self._pool = ThreadPoolExecutor(
             max_workers=self.depth, thread_name_prefix="twtml-stats-fetch"
         )
         self._pending: list = []  # [(future, batch, t)] oldest first
         self._dispatched = 0
+        # checkpoint cadence runs on its own MONOTONIC counter: a
+        # refund_dispatch must not make the cap accounting pass a cadence
+        # point twice or skip it (r3 advisor finding)
+        self._cadence = 0
+        self._last_boundary = 0
 
     def _emit_one(self) -> None:
         future, batch, t = self._pending.pop(0)
@@ -564,9 +573,11 @@ class FetchPipeline:
             self._drain()
             return
         # backpressure + timeliness: block down to depth-1 in flight, then
-        # opportunistically consume whatever already finished
+        # opportunistically consume whatever already finished (skipped in
+        # deterministic/multi-host mode — see the class docstring)
         while len(self._pending) >= self.depth or (
-            self._pending and self._pending[0][0].done()
+            not self.deterministic
+            and self._pending and self._pending[0][0].done()
         ):
             self._emit_one()
             if stop is not None and stop():
@@ -577,10 +588,16 @@ class FetchPipeline:
             out = self.model.step(pack_batch(batch))  # MAIN-thread dispatch
         else:
             out = self.model.step(batch)  # dispatch on the MAIN thread
-        self._pending.append((self._pool.submit(jax.device_get, out), batch, t))
+        self._pending.append(
+            (self._pool.submit(self._fetch or jax.device_get, out), batch, t)
+        )
         self._dispatched += 1
-        if self.boundary_every and self._dispatched % self.boundary_every == 0:
+        self._cadence += 1
+        if self.boundary_every and (
+            self._cadence - self._last_boundary >= self.boundary_every
+        ):
             self._drain()  # cadence point: weights current for checkpoints
+            self._last_boundary = self._cadence
 
     def refund_dispatch(self) -> None:
         """Give back one ``max_dispatch`` slot — called by handlers that
@@ -624,6 +641,17 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
     buckets guarantee — unpinned buckets are an error, matching the
     pre-compile contract (``warmup_compile``)."""
     import jax
+
+    from ..utils.rss import RssWatchdog
+
+    # RSS watchdog on the batch cadence: the long-running loops are where
+    # the axon-client transfer-buffer retention accumulates (utils/rss.py)
+    watchdog = RssWatchdog()
+    guarded_handle = handle
+
+    def handle(out, batch, t, at_boundary=True):  # noqa: F811
+        watchdog.tick()
+        guarded_handle(out, batch, t, at_boundary=at_boundary)
 
     multihost = jax.process_count() > 1
     k = int(getattr(conf, "superBatch", 1) or 1)
@@ -698,20 +726,27 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
 
     # the ragged wire additionally ships as ONE packed buffer (measured
     # +11.4% paired — per-array request overhead stops hiding once the
-    # wire is lean; bit-identical unpack inside the jit step)
-    pack = bool(getattr(stream, "ragged", False))
+    # wire is lean; bit-identical unpack inside the jit step). Sharded
+    # models take the ragged batch directly instead (a packed buffer has
+    # no row sharding; ParallelSGDModel.step shard-aligns it).
+    pack = bool(getattr(stream, "ragged", False)) and getattr(
+        model, "accepts_packed", False
+    )
 
     if k <= 1:
         if conf.seconds <= 0:
             # back-to-back: concurrent in-order stats fetches pipeline the
             # transport round trip (measured 6.2x paired at depth 8 —
             # FetchPipeline); checkpoint cadence points drain the pipeline
-            # so saves see current weights
+            # so saves see current weights. Multi-host runs emit only at
+            # deterministic points so stop/refund side effects land on the
+            # same tick on every lockstep host.
             pipe = FetchPipeline(
                 model, handle, stop_requested=stop_requested,
                 boundary_every=boundary_every,
                 max_dispatch=max_dispatch,
                 pack=pack,
+                deterministic=multihost,
             )
             if multihost:
                 pipeline_ref.append(pipe)  # empty-batch refunds (above)
@@ -730,7 +765,9 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
                 wire = pack_batch(batch)
             else:
                 wire = batch
-            out = jax.device_get(model.step(wire))
+            out = model.step(wire)
+            fetch = getattr(model, "fetch_output", None)
+            out = fetch(out) if fetch else jax.device_get(out)
             handle(out, batch, t, at_boundary=True)
 
         stream.foreach_batch(skip_empty(per_batch))

@@ -232,6 +232,31 @@ def _register_ragged():
 _register_ragged()
 
 
+def _shard_segment_need(rb: "RaggedUnitBatch", num_shards: int) -> int:
+    """Raw units each shard segment must hold (the longest shard's real
+    units) — the ONE shard-boundary computation align/bucket share."""
+    b = rb.mask.shape[0]
+    if b % num_shards:
+        raise ValueError(f"batch rows {b} not divisible by {num_shards} shards")
+    offs = np.asarray(rb.offsets, np.int64)
+    starts = offs[0 : b + 1 : b // num_shards]
+    return int((starts[1:] - starts[:-1]).max())
+
+
+def ragged_shard_bucket(rb: "RaggedUnitBatch", num_shards: int) -> int:
+    """The per-shard sub-buffer capacity ``align_ragged_shards`` would pick
+    for this batch — exposed so multi-host assembly can allgather-max it
+    across processes and pass the agreed value back as ``unit_bucket``
+    (every host must compile the same program shapes)."""
+    if rb.num_shards == num_shards:
+        return rb.units.shape[0] // num_shards
+    need = _shard_segment_need(rb, num_shards)
+    return max(
+        RAGGED_UNIT_MULTIPLE,
+        -(-need // RAGGED_UNIT_MULTIPLE) * RAGGED_UNIT_MULTIPLE,
+    )
+
+
 def align_ragged_shards(
     rb: "RaggedUnitBatch", num_shards: int, unit_bucket: int = 0
 ) -> "RaggedUnitBatch":
@@ -242,29 +267,32 @@ def align_ragged_shards(
     process compiles the same program); 0 sizes it from this batch's
     longest shard, rounded to RAGGED_UNIT_MULTIPLE."""
     if rb.num_shards == num_shards:
-        if unit_bucket and rb.units.shape[0] != num_shards * unit_bucket:
+        cur = rb.units.shape[0] // num_shards
+        if not unit_bucket or unit_bucket == cur:
+            return rb
+        if unit_bucket < cur:
             raise ValueError(
-                f"batch is aligned to sub-buffers of "
-                f"{rb.units.shape[0] // num_shards} units, not the pinned "
-                f"bucket {unit_bucket}"
+                f"batch is aligned to sub-buffers of {cur} units; cannot "
+                f"shrink to the pinned bucket {unit_bucket}"
             )
-        return rb
+        # grow each sub-buffer to the pinned bucket (a multi-host agreed
+        # bucket can exceed this host's local need — e.g. every process
+        # owning ONE data shard, where a flat batch is trivially aligned);
+        # segment-relative offsets are untouched by tail padding
+        grown = np.zeros((num_shards, unit_bucket), rb.units.dtype)
+        grown[:, :cur] = np.asarray(rb.units).reshape(num_shards, cur)
+        return RaggedUnitBatch(
+            grown.reshape(-1), rb.offsets, rb.numeric, rb.label, rb.mask,
+            row_len=rb.row_len, num_shards=num_shards,
+        )
     if rb.num_shards != 1:
         raise ValueError("batch is already shard-aligned; re-align from flat")
     b = rb.mask.shape[0]
-    if b % num_shards:
-        raise ValueError(
-            f"batch rows {b} not divisible by {num_shards} shards"
-        )
     b_local = b // num_shards
+    need = _shard_segment_need(rb, num_shards)
+    n_sb = ragged_shard_bucket(rb, num_shards)
     offs = np.asarray(rb.offsets, np.int64)
     starts = offs[0 : b + 1 : b_local]  # shard boundaries, [S+1]
-    seg_lens = starts[1:] - starts[:-1]
-    need = int(seg_lens.max()) if num_shards else 0
-    n_sb = max(
-        RAGGED_UNIT_MULTIPLE,
-        -(-need // RAGGED_UNIT_MULTIPLE) * RAGGED_UNIT_MULTIPLE,
-    )
     if unit_bucket:
         if need > unit_bucket:
             raise ValueError(

@@ -481,6 +481,10 @@ class StreamingSGDModel:
     prediction_fn = None  # identity when None
     round_predictions = True
     default_step_size = 0.1
+    # single-device steps unpack the one-buffer wire in-program; sharded
+    # models don't (a packed buffer has no row sharding), so the app-side
+    # pack opt-in keys off this capability (apps/common.py)
+    accepts_packed = True
 
     def __init__(
         self,

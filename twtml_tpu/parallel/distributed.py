@@ -17,7 +17,7 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-from ..features.batch import FeatureBatch, UnitBatch
+from ..features.batch import FeatureBatch, RaggedUnitBatch, UnitBatch
 from ..utils import get_logger
 
 log = get_logger("parallel.distributed")
@@ -122,16 +122,36 @@ class MultiHostSGDModel:
     _local_rows = staticmethod(local_rows)
 
     def step(self, local_batch):
-        out = self.inner.step(
+        """Dispatch only — returns the StepOutput with predictions still
+        GLOBAL (row-sharded). Localization + host transfer live in
+        ``fetch_output`` so the main thread never blocks a transport round
+        trip at dispatch time (r3 advisor: the synchronous lead-side
+        ``local_rows`` here re-introduced exactly the per-batch sync the
+        FetchPipeline exists to remove)."""
+        return self.inner.step(
             host_local_batch_to_global(local_batch, self.mesh)
         )
-        # only the lead's handler consumes per-row predictions (telemetry
-        # is lead-owned); followers skip the blocking device→host fetch —
-        # each fetch is a full transport round trip (BENCHMARKS.md)
-        return out._replace(
+
+    def fetch_output(self, out):
+        """StepOutput → host numpy, the model-aware form of
+        ``jax.device_get`` the fetch paths use (FetchPipeline workers and
+        the wall-clock per-batch fetch): global scalars for every host,
+        predictions localized to THIS host's contributed rows on the lead
+        only (telemetry is lead-owned; followers skip the row fetch —
+        each is a full transport round trip, BENCHMARKS.md)."""
+        from ..models.base import StepOutput
+
+        count, mse, real_stdev, pred_stdev = jax.device_get(
+            (out.count, out.mse, out.real_stdev, out.pred_stdev)
+        )
+        return StepOutput(
             predictions=(
                 self._local_rows(out.predictions) if self._lead else None
-            )
+            ),
+            count=count,
+            mse=mse,
+            real_stdev=real_stdev,
+            pred_stdev=pred_stdev,
         )
 
     def step_many(self, stacked):
@@ -141,12 +161,21 @@ class MultiHostSGDModel:
 
 
 def host_local_batch_to_global(
-    batch: FeatureBatch | UnitBatch, mesh
-) -> FeatureBatch | UnitBatch:
+    batch: FeatureBatch | UnitBatch | RaggedUnitBatch, mesh
+) -> FeatureBatch | UnitBatch | RaggedUnitBatch:
     """Assemble each host's locally-featurized rows into one global
-    row-sharded batch (multi-host stream sharding), for either wire format
-    (host-hashed tokens or raw code units). Single-process: no-op beyond
-    device placement.
+    row-sharded batch (multi-host stream sharding), for any wire format
+    (host-hashed tokens, raw code units, or the ragged wire). Single
+    process: no-op beyond device placement.
+
+    Ragged wire: each host re-lays its rows into its LOCAL data shards'
+    segments (``align_ragged_shards``), with the per-shard sub-buffer
+    capacity AGREED across processes by one tiny allgather-max of each
+    host's requirement — the lockstep scheduler guarantees every host
+    assembles on every tick, so the collective always pairs, and the
+    agreed bucket keeps every host's compiled program shapes identical
+    (the lockstep contract). The r3 narrow-wire harmonization applies to
+    the ragged units too.
 
     Topology requirement: per-host intake sharding assumes the mesh's data
     axis is PROCESS-ALIGNED (each data shard's devices belong to one
@@ -155,7 +184,7 @@ def host_local_batch_to_global(
     host's devices hold rows of every data shard; such layouts must ship
     the full batch from each host via `shard_batch` instead (see
     tests/distributed_worker.py's 2d mode)."""
-    from jax.sharding import NamedSharding
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from .sharding import _pspecs_for
 
@@ -163,6 +192,47 @@ def host_local_batch_to_global(
         from .sharding import shard_batch
 
         return shard_batch(batch, mesh)
+
+    def to_global(host_arr, spec):
+        sharding = NamedSharding(mesh, spec)
+        global_shape = (
+            host_arr.shape[0] * jax.process_count(),
+        ) + host_arr.shape[1:]
+        return jax.make_array_from_process_local_data(
+            sharding, np.asarray(host_arr), global_shape
+        )
+
+    if isinstance(batch, RaggedUnitBatch):
+        from jax.experimental import multihost_utils
+
+        from ..features.batch import align_ragged_shards, ragged_shard_bucket
+
+        if batch.units.dtype != np.uint16:
+            batch = RaggedUnitBatch(
+                np.asarray(batch.units, np.uint16), batch.offsets,
+                batch.numeric, batch.label, batch.mask,
+                row_len=batch.row_len, num_shards=batch.num_shards,
+            )
+        data_axis = mesh.axis_names[0]
+        num_data = mesh.shape[data_axis]
+        local_shards = num_data // jax.process_count()
+        need = ragged_shard_bucket(batch, local_shards)
+        agreed = int(
+            multihost_utils.process_allgather(
+                np.array([need], np.int64)
+            ).max()
+        )
+        batch = align_ragged_shards(batch, local_shards, unit_bucket=agreed)
+        spec = P(data_axis)
+        return RaggedUnitBatch(
+            *(to_global(a, spec) for a in (
+                batch.units, batch.offsets, batch.numeric, batch.label,
+                batch.mask,
+            )),
+            row_len=batch.row_len,
+            num_shards=num_data,
+        )
+
     if isinstance(batch, UnitBatch) and batch.units.dtype != np.uint16:
         # the units wire dtype is per-batch metadata (uint8 iff every row
         # is ASCII, featurizer._pad_ragged_units); cross-process assembly
@@ -171,12 +241,6 @@ def host_local_batch_to_global(
         # DCN, not the single-host transport the narrow wire optimizes)
         batch = batch._replace(units=batch.units.astype(np.uint16))
     specs = _pspecs_for(type(batch), mesh.axis_names[0])
-    arrays = []
-    for host_arr, spec in zip(batch, specs):
-        sharding = NamedSharding(mesh, spec)
-        global_shape = (host_arr.shape[0] * jax.process_count(),) + host_arr.shape[1:]
-        arrays.append(
-            jax.make_array_from_process_local_data(sharding, np.asarray(host_arr),
-                                                   global_shape)
-        )
-    return type(batch)(*arrays)
+    return type(batch)(*(
+        to_global(host_arr, spec) for host_arr, spec in zip(batch, specs)
+    ))

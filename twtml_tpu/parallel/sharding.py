@@ -504,8 +504,18 @@ class ParallelSGDModel:
                 f"mesh's data axis"
             )
 
-    def step(self, batch: FeatureBatch | UnitBatch) -> StepOutput:
+    def step(
+        self, batch: FeatureBatch | UnitBatch | RaggedUnitBatch
+    ) -> StepOutput:
         self._check_rows(batch.mask.shape[0])
+        if (
+            isinstance(batch, RaggedUnitBatch)
+            and batch.num_shards != self.num_data
+        ):
+            # host ragged batch straight from a featurizer: re-lay into
+            # per-shard segments + place (a no-op for pre-aligned batches,
+            # e.g. the multi-host global assembly)
+            batch = shard_batch(batch, self.mesh)
         self._weights, out = self._step_for(type(batch))(self._weights, batch)
         return out
 
