@@ -240,3 +240,38 @@ class TestLightningClient:
         path, payload = calls[-1]
         assert path == "/visualizations/42/data/"
         assert payload["data"] == {"x": [1.0, 2.0], "y": [3.0, 4.0], "label": [0, 1]}
+
+
+def test_rss_watchdog_warns_on_growth(caplog):
+    """utils/rss.py: the watchdog samples on its tick cadence and warns at
+    each threshold step of growth — the r4 guard for the axon-client
+    transfer-buffer retention (BENCHMARKS.md r3 soak)."""
+    import logging
+
+    from twtml_tpu.utils import rss as rss_mod
+
+    wd = rss_mod.RssWatchdog(warn_growth_mb=100.0, sample_every=2)
+    samples = iter([1000.0, 1050.0, 1101.0, 1140.0, 1250.0])
+    orig = rss_mod.rss_mb
+    rss_mod.rss_mb = lambda: next(samples)
+    try:
+        with caplog.at_level(logging.WARNING, logger="twtml_tpu.utils.rss"):
+            for _ in range(10):
+                wd.tick()
+    finally:
+        rss_mod.rss_mb = orig
+    # growth crossed 100 MB at sample 3 (1101) and the next step at 1250
+    assert wd.warn_count == 2
+    assert wd.last_mb == 1250.0
+    msgs = [r.message for r in caplog.records]
+    assert any("checkpoint-restart" in m for m in msgs)
+
+
+def test_rss_watchdog_disabled_by_zero_threshold():
+    from twtml_tpu.utils.rss import RssWatchdog
+
+    wd = RssWatchdog(warn_growth_mb=0.0, sample_every=1)
+    for _ in range(5):
+        wd.tick()
+    assert wd.warn_count == 0
+    assert wd.last_mb is not None

@@ -66,9 +66,15 @@ def main(argv=None) -> None:
         "dense_ragged_b2048": arm(1000, 2048, 0.0),
         "hash2e18_ragged_b1024": arm(2**18, 1024, 0.1),
     }
+    from twtml_tpu.utils.rss import RssWatchdog
+
     reference_mse: dict[str, float] = {}
     passes = {k: 0 for k in arms}
     rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    # the same guard the app loops run (utils/rss.py): sample every pass,
+    # warn with the axon-client diagnosis + checkpoint-restart workaround
+    # as growth crosses each threshold — the soak records whether it fired
+    watchdog = RssWatchdog(sample_every=1)
     t_end = time.perf_counter() + minutes * 60
     while time.perf_counter() < t_end:
         for name, (model, fz, chunks) in arms.items():
@@ -83,6 +89,7 @@ def main(argv=None) -> None:
                     f"{mse} != {reference_mse[name]}"
                 )
             passes[name] += 1
+            watchdog.tick()
     rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
     print(json.dumps({
         "soak_minutes": minutes,
@@ -92,6 +99,7 @@ def main(argv=None) -> None:
         "final_mse": reference_mse,
         "bit_identical": True,
         "rss_growth_mb": round((rss1 - rss0) / 1024, 1),
+        "rss_watchdog_warnings": watchdog.warn_count,
         "backend": jax.default_backend(),
     }))
 

@@ -155,6 +155,10 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
         else 0
     )
 
+    from ..utils.rss import RssWatchdog
+
+    watchdog = RssWatchdog()  # axon-client retention guard (utils/rss.py)
+
     def on_batch_multihost(statuses: list[Status], _batch_time) -> None:
         """Per-host sharded k-means batch: local rows → one global
         row-sharded point matrix (`host_local_rows_to_global`), the
@@ -210,6 +214,7 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
             return
         totals["count"] += n_global
         totals["batches"] += 1
+        watchdog.tick()
         if lead:
             print(
                 f"count: {totals['count']}  batch: {n_global}  "
@@ -263,6 +268,7 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
         pred = model.predict(scaled[:n])
         totals["count"] += n
         totals["batches"] += 1
+        watchdog.tick()
         centers = model.latest_centers
         print(
             f"count: {totals['count']}  batch: {n}  "

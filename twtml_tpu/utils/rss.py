@@ -1,0 +1,82 @@
+"""Process-RSS watchdog for long-running loops.
+
+Why this exists: the r3 20-minute soak measured host RSS growing
+proportionally to UPLOADED BYTES (~4–6 MB per 65k-tweet pass) through the
+tunnel-attached TPU transport, while the identical pipeline on the CPU
+backend stayed flat — the retention is in the axon tunnel client's
+host-side transfer buffers, not in framework allocations (BENCHMARKS.md
+"Endurance soaks", tools/soak.py). The framework cannot free another
+library's buffers, so the guard is operational: sample RSS cheaply on a
+batch cadence, warn with the diagnosis and the workaround when growth
+passes a threshold, and keep warning at each further threshold step. The
+workaround is bounded process lifetime — checkpoint-restart is cheap here
+(``--checkpointDir``/``--checkpointEvery`` resume exactly,
+apps/common.AppCheckpoint), so a supervisor can recycle the process
+before the leak matters. Locally-attached runtimes never trip it.
+"""
+
+from __future__ import annotations
+
+import os
+import resource
+
+from .logging import get_logger
+
+log = get_logger("utils.rss")
+
+
+def rss_mb() -> float:
+    """Current resident set size in MB (statm is a no-syscall read on
+    Linux; ru_maxrss — the high-water mark — is the portable fallback)."""
+    try:
+        with open("/proc/self/statm") as fh:
+            pages = int(fh.read().split()[1])
+        return pages * os.sysconf("SC_PAGESIZE") / 1e6
+    except Exception:
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+class RssWatchdog:
+    """``tick()`` once per batch; samples every ``sample_every`` ticks and
+    warns when RSS has grown ``warn_growth_mb`` beyond the first sample
+    (then again at each further ``warn_growth_mb`` of growth).
+
+    ``TWTML_RSS_WARN_MB`` overrides the threshold; 0 disables the warning
+    (sampling still happens so callers can read ``last_mb``)."""
+
+    def __init__(
+        self, warn_growth_mb: float | None = None, sample_every: int = 64
+    ):
+        if warn_growth_mb is None:
+            warn_growth_mb = float(os.environ.get("TWTML_RSS_WARN_MB", 2048))
+        self.warn_growth_mb = warn_growth_mb
+        self.sample_every = max(1, sample_every)
+        self.last_mb: float | None = None
+        self.warn_count = 0
+        self._base: float | None = None
+        self._next_warn = warn_growth_mb
+        self._ticks = 0
+
+    def tick(self) -> None:
+        self._ticks += 1
+        if self._ticks % self.sample_every:
+            return
+        cur = rss_mb()
+        self.last_mb = cur
+        if self._base is None:
+            self._base = cur
+            return
+        growth = cur - self._base
+        if self.warn_growth_mb > 0 and growth >= self._next_warn:
+            log.warning(
+                "process RSS grew %.0f MB since start (now %.0f MB). On the "
+                "tunnel-attached TPU transport this matches the known "
+                "axon-client transfer-buffer retention (grows with uploaded "
+                "bytes; the same pipeline is flat on CPU — BENCHMARKS.md r3 "
+                "soak). Workaround for long-lived runs: bound process "
+                "lifetime via checkpoint-restart (--checkpointDir + "
+                "--checkpointEvery resume exactly).",
+                growth, cur,
+            )
+            self.warn_count += 1
+            self._next_warn = growth + self.warn_growth_mb
