@@ -120,6 +120,9 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
 
     log.info("Starting the streaming computation...")
     tracer.start()
+    import time as _time
+
+    t_stream = _time.perf_counter()
     ssc.start(lockstep=lockstep)
     try:
         ssc.await_termination()
@@ -128,6 +131,11 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     finally:
         ssc.stop()
         flush_group()  # drain a partial superbatch group before final state
+        # the post-warmup streaming window (start → last batch drained):
+        # what a steady-state rate should be computed over — session init,
+        # model build, and the warmup compile are startup, not streaming
+        # (the suite's twitter_live config reads this, VERDICT r3 #4)
+        totals["stream_seconds"] = _time.perf_counter() - t_stream
         tracer.stop()
         ckpt.final_save(totals)
     if ssc.failed:
