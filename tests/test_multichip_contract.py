@@ -16,7 +16,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.mark.parametrize("n", [1, 2, 4, 16])
+@pytest.mark.parametrize("n", [1, 2, 4, 8, 16])
 def test_dryrun_multichip_contract_point(n):
     env = dict(os.environ)
     env.update(JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
