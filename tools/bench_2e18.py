@@ -121,7 +121,9 @@ def main(argv=None) -> None:
 
     pipeline_arm("padded_b2048", 2048, "padded")  # the r2 operating point
     pipeline_arm("ragged_b2048", 2048, "ragged", int8=True)
-    pipeline_arm("ragged_b1024", 1024, "ragged", int8=True)  # int8 G plane
+    pipeline_arm("ragged_b3072", 3072, "ragged", int8=True)  # r4 point
+    pipeline_arm("ragged_b4096", 4096, "ragged", int8=True)  # past-the-optimum
+    pipeline_arm("ragged_b1024", 1024, "ragged", int8=True)  # r3 point
     pipeline_arm("ragged_b1024_bf16", 1024, "ragged", int8=False)  # r3 plane A/B
     pipeline_arm("ragged_b2048_bf16", 2048, "ragged", int8=False)
     pipeline_arm("ragged_b512", 512, "ragged")

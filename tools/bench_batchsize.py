@@ -1,0 +1,107 @@
+"""Headline-config batch-size sweep (r4): is 2048 still the right batch
+for the dense ragged+packed flagship pipeline?
+
+Why re-ask: the upload-bound tunnel's effective bandwidth IMPROVES with
+transfer size (BENCHMARKS.md "Measurement integrity"), and the r3 wire
+work (ragged + packed) changed the bytes-per-batch landscape the r2
+choice of 2048 was made in. Larger batches amortize per-batch fixed
+costs (dispatch, the packed-buffer assembly, featurize-call overhead);
+smaller ones pipeline more finely. Device compute is nowhere near
+binding on this config, so the answer is all transport/host.
+
+Arms interleave round-robin within one window (tunnel phase swings hit
+every arm equally) and the report gives paired per-round ratios vs the
+b2048 incumbent — the same methodology as tools/bench_2e18.py.
+
+Usage: python tools/bench_batchsize.py [--tweets N] [--budget S]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    n_tweets, budget = 131072, 300.0
+    batches = (1024, 2048, 4096, 8192, 16384, 32768)
+    i = 0
+    while i < len(args):
+        if args[i] == "--tweets":
+            n_tweets = int(args[i + 1]); i += 2
+        elif args[i] == "--budget":
+            budget = float(args[i + 1]); i += 2
+        elif args[i] == "--batches":
+            batches = tuple(int(b) for b in args[i + 1].split(",")); i += 2
+        else:
+            raise SystemExit(f"unknown flag {args[i]!r}")
+    if 2048 not in batches:
+        batches = (2048,) + batches  # the paired baseline arm
+
+    import jax
+
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.models import StreamingLinearRegressionWithSGD
+    from twtml_tpu.streaming.sources import SyntheticSource
+    from twtml_tpu.utils.benchloop import _run_once
+
+    feat = Featurizer(now_ms=1785320000000)
+    statuses = list(SyntheticSource(total=n_tweets, seed=3).produce())
+
+    arms: dict = {}
+
+    def arm(batch):
+        chunks = [
+            statuses[i : i + batch] for i in range(0, len(statuses), batch)
+        ]
+
+        def fz(c, batch=batch):
+            return feat.featurize_batch_ragged(
+                c, row_bucket=batch, pre_filtered=True, pack=True
+            )
+
+        m = StreamingLinearRegressionWithSGD()
+        for _ in range(2):
+            float(m.step(fz(chunks[0])).mse)  # completion-fetch warmup
+
+        def one_pass(m=m, fz=fz, chunks=chunks):
+            m.reset()
+            return _run_once(m, fz, chunks, prefetch=True)
+
+        arms[f"b{batch}"] = one_pass
+
+    for b in batches:
+        arm(b)
+
+    times: dict[str, list] = {k: [] for k in arms}
+    t_end = time.perf_counter() + budget
+    while time.perf_counter() < t_end:
+        for name, run in arms.items():
+            dt, _ = run()
+            times[name].append(dt)
+
+    out = {"config": "headline_batch_sweep", "tweets": n_tweets,
+           "backend": jax.default_backend(), "rounds": len(times["b2048"])}
+    base = times["b2048"]
+    for name, ts in times.items():
+        out[name] = {
+            "best": round(n_tweets / min(ts), 1),
+            "median": round(n_tweets / statistics.median(ts), 1),
+        }
+        if name != "b2048":
+            out[name]["paired_speedup_median"] = round(
+                statistics.median([b / t for b, t in zip(base, ts)]), 3
+            )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

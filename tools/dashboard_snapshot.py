@@ -46,7 +46,12 @@ def real_training_frames(batches: int = 36, batch: int = 64):
     )
     feat = Featurizer(now_ms=1785320000000)
     model = StreamingLinearRegressionWithSGD()
-    frames, total = [], 0
+    # the session-opening Config frame the app publishes first
+    # (telemetry/session_stats.py) — it carries the session id the footer
+    # shows; no Lightning host here, so no viz iframes
+    frames: list = [{"jsonClass": "Config", "id": "r4-snapshot", "host": "",
+                     "viz": []}]
+    total = 0
     for i in range(0, len(statuses), batch):
         fb = feat.featurize_batch_units(
             statuses[i : i + batch], row_bucket=batch, pre_filtered=True
@@ -107,9 +112,11 @@ def run_dashboard(frames):
     return h, ctx.calls
 
 
-def canvas_calls_to_svg(calls, width, height):
-    """Replay recorded canvas ops into SVG elements. Only the ops chart.js
-    uses are supported (the stub records exactly those)."""
+def canvas_calls_to_svg(calls, x_scale: float = 1.0):
+    """Replay recorded canvas ops into SVG elements, scaling x coordinates
+    at emission (an x-only GROUP transform would stretch the text glyphs).
+    Only the ops chart.js uses are supported (the stub records exactly
+    those)."""
     # keep only the ops of the LAST full redraw (chart.js clears first)
     last_clear = max(
         (i for i, c in enumerate(calls) if c[0] == "clearRect"), default=-1
@@ -128,7 +135,7 @@ def canvas_calls_to_svg(calls, width, height):
         if op == "beginPath":
             path = []
         elif op == "moveTo" or op == "lineTo":
-            path.append((float(args[0]), float(args[1])))
+            path.append((float(args[0]) * x_scale, float(args[1])))
         elif op == "stroke" and path:
             pts = " ".join(f"{x:.1f},{y:.1f}" for x, y in path)
             out.append(
@@ -141,12 +148,13 @@ def canvas_calls_to_svg(calls, width, height):
         elif op == "fillRect":
             x, y, w, hh = (float(a) for a in args[:4])
             out.append(
-                f'<rect x="{x:g}" y="{y:g}" width="{w:g}" height="{hh:g}" '
-                f'fill="{style["fillStyle"]}" />'
+                f'<rect x="{x * x_scale:g}" y="{y:g}" width="{w:g}" '
+                f'height="{hh:g}" fill="{style["fillStyle"]}" />'
             )
         elif op == "fillText":
             out.append(
-                f'<text x="{float(args[1]):g}" y="{float(args[2]):g}" '
+                f'<text x="{float(args[1]) * x_scale:g}" '
+                f'y="{float(args[2]):g}" '
                 f'fill="{style["fillStyle"]}" font-size="12" '
                 f'font-family="system-ui, sans-serif">'
                 f"{html.escape(str(args[0]))}</text>"
@@ -177,11 +185,11 @@ def build_svg(h, calls) -> str:
             font-family="system-ui, sans-serif">{value}</text>
     </g>""")
     conn = html.escape(h.el("conn").text or "?")
-    chart_svg = canvas_calls_to_svg(calls, cw, ch)
     width = x0 * 2 + len(labels) * (tile_w + gap) - gap
     chart_y = y0 + 64 + 24
     height = chart_y + ch + 56
     scale = (width - 2 * x0) / cw
+    chart_svg = canvas_calls_to_svg(calls, x_scale=scale)
     return f"""<svg xmlns="http://www.w3.org/2000/svg" width="{width}" height="{height:.0f}"
      viewBox="0 0 {width} {height:.0f}" font-family="system-ui, sans-serif">
   <rect width="100%" height="100%" fill="white"/>
@@ -191,9 +199,9 @@ def build_svg(h, calls) -> str:
   <text x="{width - 67}" y="34" font-size="12" fill="white"
         text-anchor="middle">{conn}</text>
   {''.join(tiles)}
-  <g transform="translate({x0},{chart_y}) scale({scale:.4f},1)">
-    <rect x="0" y="0" width="{cw:g}" height="{ch:g}" rx="8" fill="none"
-          stroke="rgba(128,128,128,0.25)"/>
+  <g transform="translate({x0},{chart_y})">
+    <rect x="0" y="0" width="{cw * scale:g}" height="{ch:g}" rx="8"
+          fill="none" stroke="rgba(128,128,128,0.25)"/>
     {chart_svg}
   </g>
   <text x="20" y="{height - 20:.0f}" font-size="11" fill="#999">
