@@ -106,10 +106,28 @@ def _pipeline_rate(model, feat, statuses, batch_size, row_multiple=1, shard=None
 
 
 def run_config(name: str, n_tweets: int, batch_size: int = 0) -> dict:
-    """``batch_size`` 0 = the suite default (2048), which lets per-config
-    operating points apply; an explicit value is honored everywhere."""
+    """``batch_size`` 0 = the per-config r4 operating point (the dict
+    below; 2048 where no sweep moved it); an explicit value is honored
+    everywhere."""
     explicit_batch = batch_size > 0
-    batch_size = batch_size or 2048
+    # per-config r4 operating points (paired sweeps, BENCHMARKS.md "r4
+    # operating point"): the upload-bound transport rewards larger batches
+    # once per-batch fixed costs dominate — block ingest (#1) measured
+    # 1.155x paired at b8192 vs b2048; the object-ingest dense pipeline
+    # (#3 shares the headline's profile) 1.62x at b16384; the 2^18 Gram
+    # config (#4) peaks at 3072 (the int8 plane relieved the B-scaling
+    # wall; >=6144 exceeds the fits_gram gate). Mesh configs keep 2048
+    # (program validation on a virtual CPU mesh, not a speed claim).
+    # Explicit --batch always wins; default batches cap at n_tweets/4 so
+    # a small-corpus run still measures a multi-chunk pipeline instead of
+    # one half-padding batch.
+    if not explicit_batch:
+        batch_size = {
+            "replay_linear": 8192,
+            "logistic_sentiment": 16384,
+            "hashing_2e18_l2": 3072,
+        }.get(name, 2048)
+        batch_size = max(256, min(batch_size, n_tweets // 4 or batch_size))
     import jax
 
     from twtml_tpu.features.featurizer import Featurizer
@@ -189,14 +207,26 @@ def run_config(name: str, n_tweets: int, batch_size: int = 0) -> dict:
                 # the compile warmup runs before ssc.start (warmup_compile),
                 # and per-batch stats ride the app's default FetchPipeline —
                 # counting startup in the denominator made r3's full-app
-                # number ~6k while the stages ran 34-79k (VERDICT r3 #4)
-                t0 = time.perf_counter()
-                totals = app.run(conf, max_batches=n_batches)
-                dt = time.perf_counter() - t0
+                # number ~6k while the stages ran 34-79k (VERDICT r3 #4).
+                # Best-of-3 app runs (each reconnects and replays the
+                # server's stream): this is a single-pass measurement
+                # otherwise, and the tunnel's multi-second stall bursts
+                # land INSIDE one window often enough to fake a 100×
+                # regression (a full-suite run recorded 140 s for a window
+                # that re-measures at ~3 s)
+                best = None
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    totals = app.run(conf, max_batches=n_batches)
+                    dt = time.perf_counter() - t0
+                    stream_s = totals.get("stream_seconds") or dt
+                    rec = (stream_s, dt, totals)
+                    if best is None or stream_s < best[0]:
+                        best = rec
+                stream_s, dt, totals = best
         finally:
             _twtml_config._SYSTEM_PROPERTIES.clear()
             _twtml_config._SYSTEM_PROPERTIES.update(saved_props)
-        stream_s = totals.get("stream_seconds") or dt
         return {
             **out,
             "mode": "local-protocol",
@@ -376,22 +406,18 @@ def run_config(name: str, n_tweets: int, batch_size: int = 0) -> dict:
         model = StreamingLinearRegressionWithSGD(
             num_text_features=2**18, l2_reg=0.1
         )
-        # r4 operating point: batch 3072. The int8 G plane relieved the
-        # B-scaling Gram wall (its per-tweet FLOPs scale with batch size),
-        # so the upload/fixed-cost amortization of larger batches wins
-        # again up to 3072 (paired long-pass sweeps: b2048 1.29x, b3072
-        # 1.44x vs the r3 b1024 point; b4096 0.86x vs b3072 — G
-        # reasserts; >=6144 exceeds the fits_gram HBM gate and falls to
-        # the scatter loop). r3's --superBatch NEGATIVE finding stands.
-        # (explicit --batch requests — tests, A/B runs, tiny corpora — are
-        # honored; only the suite DEFAULT moves to the operating point)
-        b4 = batch_size if explicit_batch else 3072
-        if b4 != batch_size:
+        # batch: the r4 operating point (3072) via the per-config defaults
+        # above — paired long-pass sweeps: b2048 1.29x, b3072 1.44x vs the
+        # r3 b1024 point; b4096 0.86x vs b3072 (G reasserts); >=6144
+        # exceeds the fits_gram HBM gate and falls to the scatter loop.
+        # r3's --superBatch NEGATIVE finding stands.
+        if not explicit_batch:
             out["note"] = (
-                "config #4 runs its own operating point (batch 3072 — "
-                "BENCHMARKS.md 'Config #4 operating point')"
+                f"batch {batch_size}: config #4 operating point "
+                "(BENCHMARKS.md 'Config #4 operating point')"
             )
-        out.update(_pipeline_rate(model, feat, statuses, b4, ragged=True))
+        out.update(_pipeline_rate(model, feat, statuses, batch_size,
+                                  ragged=True))
     elif name in ("sharded_dp4", "sharded_dp4_logistic", "sharded_2e18_2d"):
         from twtml_tpu.parallel import ParallelSGDModel, make_mesh
         from twtml_tpu.parallel.sharding import shard_batch
@@ -440,7 +466,9 @@ def run_config(name: str, n_tweets: int, batch_size: int = 0) -> dict:
 
 def main(argv=None) -> None:
     args = list(sys.argv[1:] if argv is None else argv)
-    n_tweets, batch_size, out_path, child = 8192, 0, "", ""  # 0 = default
+    # 65536 default tweets: the per-config default batches (up to 16384)
+    # need several chunks per pass to measure a pipeline, not one batch
+    n_tweets, batch_size, out_path, child = 65536, 0, "", ""  # batch 0 = default
     selected = list(CONFIGS)
     i = 0
     while i < len(args):

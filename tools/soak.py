@@ -3,10 +3,11 @@ and assert numeric bit-stability — the r2/r3 reliability evidence
 (BENCHMARKS.md "Endurance soaks").
 
 Each round runs, on the SAME process/models: the dense ragged-wire pipeline
-(the r3 headline path) and the 2^18 Gram config at its r3 operating point
-(batch 1024, ragged). Every pass resets weights and streams the identical
-corpus, so the final-batch mse must be BIT-IDENTICAL on every pass — any
-drift, leak-induced slowdown, or transport wedge fails loudly.
+at the r4 headline operating point (batch 16384) and the 2^18 int8-Gram
+config at its r4 operating point (batch 3072, ragged). Every pass resets
+weights and streams the identical corpus, so the final-batch mse must be
+BIT-IDENTICAL on every pass — any drift, leak-induced slowdown, or
+transport wedge fails loudly.
 
 Usage: python tools/soak.py [--minutes M] [--tweets N]
 Prints one JSON line at the end.
@@ -63,8 +64,9 @@ def main(argv=None) -> None:
         return model, fz, chunks
 
     arms = {
-        "dense_ragged_b2048": arm(1000, 2048, 0.0),
-        "hash2e18_ragged_b1024": arm(2**18, 1024, 0.1),
+        # the r4 operating points (BENCHMARKS.md "r4 operating point")
+        "dense_ragged_b16384": arm(1000, 16384, 0.0),
+        "hash2e18_ragged_b3072": arm(2**18, 3072, 0.1),
     }
     from twtml_tpu.utils.rss import RssWatchdog
 
