@@ -1,4 +1,4 @@
-"""Per-batch-telemetry regime: three fetch strategies, interleaved.
+"""Per-batch-telemetry regime: the fetch strategies, interleaved.
 
 The production apps read the full StepOutput every batch for the stats
 plane; through this build's tunnel each host fetch is a ~70-100 ms round
@@ -6,11 +6,16 @@ trip, capping the back-to-back telemetry-on rate far below the free-
 dispatch rate. Arms (single passes round-robin in one window; paired
 per-round ratios are the phase-robust comparison):
 
-- sync   : device_get right after each dispatch (the r2 baseline);
-- lag    : one-batch-lag fetch (VERDICT r2 #2's proposal) — measured
-           NEUTRAL here, kept for the record;
-- pool8  : concurrent in-order fetches on a thread pool — the measured
-           6.2x winner, shipped as apps/common.FetchPipeline.
+- sync     : device_get right after each dispatch (the r2 baseline);
+- lag      : one-batch-lag fetch (VERDICT r2 #2's proposal) — measured
+             NEUTRAL here, kept for the record;
+- pool8    : concurrent in-order fetches on a thread pool — the measured
+             6.2x winner, the mechanism FetchPipeline ships;
+- fetchpipe: the SHIPPED path end-to-end — apps/common.FetchPipeline over
+             the ragged+packed wire, per-batch handler included. This is
+             the arm behind the r4 batch-retune claim (2.2x paired at
+             --batch 16384 vs 2048: the per-batch fetch amortizes over 8x
+             more tweets — BENCHMARKS.md).
 
 Usage: python tools/bench_telemetry.py [--tweets N] [--batch B] [--budget S]
 Prints one JSON line.
@@ -112,6 +117,38 @@ def main(argv=None) -> None:
                 consume(f.result(), b, 0.0)
         return time.perf_counter() - t0
 
+    from twtml_tpu.apps.common import FetchPipeline
+
+    from twtml_tpu.features.batch import pack_batch
+
+    r_batches = [
+        feat.featurize_batch_ragged(c, row_bucket=batch, pre_filtered=True)
+        for c in chunks
+    ]
+    # warm the PACKED program the timed arm actually dispatches
+    # (pack=True → model.step(pack_batch(b)): a different jit pytree than
+    # the raw ragged batch), once per distinct wire layout — the ragged
+    # units bucket is data-dependent, so chunks can land in several
+    seen_layouts = set()
+    for rb in r_batches:
+        key = (rb.units.shape, str(rb.units.dtype), rb.row_len)
+        if key not in seen_layouts:
+            seen_layouts.add(key)
+            float(model.step(pack_batch(rb)).mse)
+    model.reset()
+
+    def fetchpipe_pass():
+        """The shipped back-to-back path verbatim: FetchPipeline (depth 8,
+        packed ragged wire) delivering every batch's StepOutput to the
+        same handler work as every other arm."""
+        model.reset()
+        t0 = time.perf_counter()
+        pipe = FetchPipeline(model, consume, depth=8, pack=True)
+        for b in r_batches:
+            pipe.on_batch(b, 0.0)
+        pipe.flush()
+        return time.perf_counter() - t0
+
     from twtml_tpu.features.batch import stack_batches
     from twtml_tpu.models.base import StepOutput
 
@@ -149,7 +186,7 @@ def main(argv=None) -> None:
                     consume(host, None, 0.0)
         return time.perf_counter() - t0
 
-    times = {"sync": [], "lag": [], "pool8": []}
+    times = {"sync": [], "lag": [], "pool8": [], "fetchpipe": []}
     if groups:
         times["super8_pool4"] = []
     t_end = time.perf_counter() + budget
@@ -157,6 +194,7 @@ def main(argv=None) -> None:
         times["sync"].append(sync_pass())
         times["lag"].append(lag_pass())
         times["pool8"].append(pool_pass())
+        times["fetchpipe"].append(fetchpipe_pass())
         if groups:
             times["super8_pool4"].append(super_pool_pass())
 
@@ -168,7 +206,9 @@ def main(argv=None) -> None:
             "tweets_per_sec_best": round(n_tweets / min(ts), 1),
             "tweets_per_sec_median": round(n_tweets / statistics.median(ts), 1),
         }
-    for name in [k for k in ("lag", "pool8", "super8_pool4") if k in times]:
+    for name in [
+        k for k in ("lag", "pool8", "fetchpipe", "super8_pool4") if k in times
+    ]:
         out[name]["paired_speedup_vs_sync"] = round(
             statistics.median(
                 [s / t for s, t in zip(times["sync"], times[name])]
