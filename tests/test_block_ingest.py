@@ -663,3 +663,47 @@ def test_fault_injection_counts_tweets_in_blocks():
     # several small blocks: crash still keyed to the tweet count
     rows, crashed = drain(256)
     assert crashed and rows < 6
+
+
+def test_byte_range_sharding_partitions_rows_exactly(feat):
+    """r5 (VERDICT r4 #4): shard_index/shard_count split the file by byte
+    range, line-aligned — every kept row lands in exactly one shard and the
+    shards' concatenation equals the unsharded parse (each host reads only
+    ~1/N of the bytes)."""
+    whole = merge_blocks(list(BlockReplayFileSource(DATA).produce()))
+    for n in (2, 3, 4):
+        shard_blocks = [
+            list(BlockReplayFileSource(
+                DATA, shard_index=i, shard_count=n, block_bytes=512
+            ).produce())
+            for i in range(n)
+        ]
+        merged = merge_blocks([b for blocks in shard_blocks for b in blocks])
+        np.testing.assert_array_equal(merged.numeric, whole.numeric)
+        np.testing.assert_array_equal(merged.units, whole.units)
+        np.testing.assert_array_equal(merged.offsets, whole.offsets)
+        np.testing.assert_array_equal(merged.ascii, whole.ascii)
+
+
+def test_drain_splits_overshooting_blocks():
+    """A ParsedBlock bigger than the drain cap splits AT the cap with the
+    remainder put back (r5) — capped drains are exactly bucket-sized, which
+    multi-host lockstep requires and which pins single-host block batch
+    shapes too."""
+    from twtml_tpu.streaming.context import StreamingContext
+    from twtml_tpu.streaming.sources import QueueSource
+
+    src = BlockReplayFileSource(DATA)
+    big = merge_blocks(list(src.produce()))
+    assert big.rows >= 4
+
+    ssc = StreamingContext(batch_interval=0)
+    ssc.raw_stream(QueueSource(), row_bucket=2)
+    ssc._queue.put(big)
+    drained = ssc._drain(2)
+    assert sum(b.rows for b in drained) == 2
+    # remainder is back at the queue FRONT, in order
+    rest = ssc._drain(0)
+    merged = merge_blocks(drained + [b for b in rest])
+    np.testing.assert_array_equal(merged.numeric, big.numeric)
+    np.testing.assert_array_equal(merged.units, big.units)

@@ -249,3 +249,54 @@ def test_float64_requires_cpu_backend(isolated_env):
     conf = ConfArguments().parse(["--dtype", "float64"])
     with pytest.raises(SystemExit):
         select_backend(conf)  # backend auto: must demand --backend cpu
+
+
+def test_default_wire_is_auto_resolving_by_regime(isolated_env):
+    """r5 (VERDICT r4 #1a): the fast path is the default path — --wire
+    auto (the default) resolves to the ragged device-hash wire (bench.py's
+    exact wire) in every back-to-back regime. Wall-clock streaming keeps
+    padded (the ragged units bucket is data-dependent, so it cannot
+    pre-compile before a live stream starts — warmup_compile); --hashOn
+    host keeps padded; explicit --wire always wins."""
+    conf = ConfArguments()
+    assert conf.wire == "auto"
+    assert conf.hashOn == "device"
+    assert conf.seconds == 5  # reference.conf default: wall-clock
+    assert conf.effective_wire() == "padded"
+    conf = ConfArguments().parse(["--seconds", "0"])
+    assert conf.effective_wire() == "ragged"  # the throughput regime
+    conf = ConfArguments().parse(["--seconds", "0", "--hashOn", "host"])
+    assert conf.effective_wire() == "padded"
+    conf = ConfArguments().parse(["--wire", "padded", "--seconds", "0"])
+    assert conf.effective_wire() == "padded"
+    conf = ConfArguments().parse(["--wire", "ragged"])
+    assert conf.effective_wire() == "ragged"
+
+
+def test_explicit_ragged_with_host_hash_rejected(isolated_env):
+    from twtml_tpu.apps.common import build_source
+
+    conf = ConfArguments().parse(["--wire", "ragged", "--hashOn", "host"])
+    with pytest.raises(SystemExit, match="device-hash wire"):
+        build_source(conf)
+
+
+def test_recycle_flag_validation(isolated_env, tmp_path):
+    """--recycleAfterMb needs --checkpointDir (recycle = checkpoint +
+    re-exec); with one it constructs armed."""
+    from twtml_tpu.apps.common import AppCheckpoint, ProcessRecycler
+
+    totals = {"count": 0, "batches": 0}
+    conf = ConfArguments().parse(["--recycleAfterMb", "4096"])
+    ckpt = AppCheckpoint(conf, lambda: None, lambda s: None, totals)
+    with pytest.raises(SystemExit, match="checkpointDir"):
+        ProcessRecycler(conf, ckpt, totals)
+    conf = ConfArguments().parse([
+        "--recycleAfterMb", "4096", "--checkpointDir", str(tmp_path),
+    ])
+    ckpt = AppCheckpoint(
+        conf, lambda: __import__("numpy").zeros(4), lambda s: None, totals
+    )
+    r = ProcessRecycler(conf, ckpt, totals)
+    assert r.threshold == 4096
+    r.check(at_boundary=True)  # far below threshold: no-op

@@ -309,3 +309,98 @@ def test_keep_alive_lines_skipped(stream_server):
     src = TwitterSource(CREDS, url=stream_server + "/stream")
     got = _collect(src, 20)
     assert all(s.text for s in got)
+
+
+# ---------------------------------------------------------------------------
+# r5: multi-host live intake (id-residue sharding) + live block ingest
+
+
+def _corpus_lines(n=40):
+    from tools.bench_suite import _status_json
+    from twtml_tpu.streaming.sources import SyntheticSource
+
+    lines = []
+    for i, s in enumerate(
+        SyntheticSource(total=n, seed=13, base_ms=1785320000000).produce()
+    ):
+        d = _status_json(s)
+        d["id"] = 1000 + i  # snowflake ids — the shard key
+        lines.append(json.dumps(d))
+    return lines
+
+
+def test_id_sharded_live_intake_disjoint_and_complete():
+    """VERDICT r4 #8: every host opens its own connection to the SAME
+    stream and keeps rows with id ≡ processId (mod N) — shard-disjoint,
+    union-complete, through the real protocol path (N concurrent
+    connections against the local v1.1 server)."""
+    from tools.localstream import LocalV11StreamServer
+    from twtml_tpu.streaming.sources import IdShardedSource
+    from twtml_tpu.streaming.twitter import TwitterSource
+
+    lines = _corpus_lines(40)
+    all_ids = set(range(1000, 1040))
+    with LocalV11StreamServer(lines) as server:
+        shard_ids = []
+        for pid in range(2):
+            src = IdShardedSource(
+                TwitterSource(CREDS, url=server.url), pid, 2
+            )
+            got = _collect(src, 20)
+            assert len(got) >= 20
+            shard_ids.append({s.id for s in got})
+    assert shard_ids[0] & shard_ids[1] == set()
+    assert shard_ids[0] | shard_ids[1] == all_ids
+    for pid in (0, 1):
+        assert all(i % 2 == pid for i in shard_ids[pid])
+
+
+def test_id_shard_wrapper_keeps_live_backoff_policy():
+    from twtml_tpu.streaming.httpstream import RateLimitedError
+    from twtml_tpu.streaming.sources import IdShardedSource
+    from twtml_tpu.streaming.twitter import TwitterSource
+
+    inner = TwitterSource(CREDS)
+    shard = IdShardedSource(inner, 0, 2)
+    assert shard.max_restarts == inner.max_restarts
+    assert shard._backoff(RateLimitedError(420, ""), 1) == 60.0
+
+
+def test_block_twitter_source_matches_object_path():
+    """r5 live --ingest block: raw stream lines → native C parser →
+    ParsedBlocks, byte-identical featurized batches vs the per-line
+    json.loads Status path (config #2's host bottleneck deleted)."""
+    import numpy as np
+
+    from tools.localstream import LocalV11StreamServer
+    from twtml_tpu.features.blocks import merge_blocks, slice_block
+    from twtml_tpu.features.featurizer import Featurizer, Status
+    from twtml_tpu.streaming.twitter import BlockTwitterSource
+
+    lines = _corpus_lines(40)
+    statuses = [Status.from_json(json.loads(ln)) for ln in lines]
+    feat = Featurizer(now_ms=1785320000000)
+
+    blocks = []
+    with LocalV11StreamServer(lines) as server:
+        src = BlockTwitterSource(
+            CREDS, url=server.url, flush_seconds=0.05,
+        )
+        src.start(blocks.append)
+        deadline = time.time() + 20.0
+        while (
+            sum(b.rows for b in blocks) < 40 and time.time() < deadline
+        ):
+            time.sleep(0.01)
+        src.stop()
+    merged = merge_blocks(list(blocks))
+    assert merged.rows >= 40
+    first = slice_block(merged, 0, 40)
+
+    obj = feat.featurize_batch_units(statuses, row_bucket=64, unit_bucket=128)
+    blk = feat.featurize_parsed_block(first, row_bucket=64, unit_bucket=128)
+    np.testing.assert_array_equal(obj.units, blk.units)
+    np.testing.assert_array_equal(obj.length, blk.length)
+    np.testing.assert_allclose(obj.numeric, blk.numeric, rtol=1e-6)
+    np.testing.assert_array_equal(obj.label, blk.label)
+    np.testing.assert_array_equal(obj.mask, blk.mask)

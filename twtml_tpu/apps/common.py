@@ -97,22 +97,15 @@ def build_source(
     import jax
 
     multihost = jax.process_count() > 1
-    if multihost:
-        # per-host intake sharding (SURVEY.md §7 stage 5): each process
-        # keeps rows i-of-N of the deterministic stream, so the union of
-        # every host's shard is exactly the single-host stream
-        if conf.source == "twitter":
-            raise SystemExit(
-                "multi-host live Twitter intake is not wired: every host "
-                "opening the same sample stream would duplicate tweets, "
-                "not shard them; use --source replay or synthetic"
-            )
-        if conf.ingest == "block":
-            raise SystemExit(
-                "--ingest block is not wired for multi-host runs; "
-                "use --ingest object"
-            )
-    if conf.wire == "ragged":
+    if multihost and conf.source == "twitter" and conf.ingest == "block":
+        # the block parser keeps no per-tweet ids, and ids are the only
+        # shard key a live stream has (IdShardedSource) — refuse the
+        # combination rather than silently double-train
+        raise SystemExit(
+            "multi-host live Twitter intake shards by tweet id, which "
+            "--ingest block does not carry; use --ingest object"
+        )
+    if conf.effective_wire() == "ragged":
         if conf.hashOn != "device":
             raise SystemExit(
                 "--wire ragged is a device-hash wire format; "
@@ -123,8 +116,13 @@ def build_source(
             "--ingest block is not wired for this entry point; "
             "use --ingest object"
         )
-    if conf.ingest == "block" and conf.source != "replay":
-        raise SystemExit("--ingest block requires --source replay")
+    if conf.ingest == "block" and conf.source not in ("replay", "twitter"):
+        raise SystemExit("--ingest block requires --source replay or twitter")
+    if conf.ingest == "block" and conf.hashOn != "device":
+        raise SystemExit(
+            "--ingest block ships raw code units (device hashing); "
+            "--hashOn host requires --ingest object"
+        )
     if conf.source == "replay":
         if not conf.replayFile:
             raise SystemExit("--source replay requires --replayFile <path.jsonl>")
@@ -136,27 +134,55 @@ def build_source(
                     "--ingest block replays as fast as possible; "
                     "drop --replaySpeed or use --ingest object"
                 )
-            if conf.hashOn != "device":
-                raise SystemExit(
-                    "--ingest block ships raw code units (device hashing); "
-                    "--hashOn host requires --ingest object"
-                )
             begin, end = (
                 block_interval
                 if block_interval is not None
                 else (conf.numRetweetBegin, conf.numRetweetEnd)
             )
+            # multi-host: byte-range shard of the file per host — each host
+            # parses ONLY its shard (SURVEY §2.4 L0: deserialization ships
+            # to every executor), so config #1's native loader feeds
+            # cluster runs too (r5; was a SystemExit)
             source: Source = BlockReplayFileSource(
-                conf.replayFile, num_retweet_begin=begin, num_retweet_end=end
+                conf.replayFile, num_retweet_begin=begin, num_retweet_end=end,
+                shard_index=jax.process_index() if multihost else 0,
+                shard_count=jax.process_count() if multihost else 1,
             )
             return _wrap_faults(source, conf)
         source = ReplayFileSource(conf.replayFile, speed=conf.replaySpeed)
     elif conf.source == "synthetic":
         source = SyntheticSource(rate=conf.replaySpeed or 0.0)
     elif conf.source == "twitter":
-        from ..streaming.twitter import TwitterSource
+        from ..streaming.twitter import BlockTwitterSource, TwitterSource
 
+        if conf.ingest == "block":
+            # live block ingest (r5): raw stream lines batch into byte
+            # blocks for the native C parser — no per-tweet Python objects
+            # between the socket and the featurizer (closes most of the
+            # config-#2 full-app vs protocol-stage gap, BENCHMARKS.md)
+            begin, end = (
+                block_interval
+                if block_interval is not None
+                else (conf.numRetweetBegin, conf.numRetweetEnd)
+            )
+            source = BlockTwitterSource.from_properties(
+                num_retweet_begin=begin, num_retweet_end=end
+            )
+            return _wrap_faults(source, conf)
         source = TwitterSource.from_properties()
+        if multihost:
+            from ..streaming.sources import IdShardedSource
+
+            # live streams shard by tweet id (id ≡ processId mod N): every
+            # host opens its own connection (duplicated ingress — tens of
+            # KB/s at real stream rates) and keeps a disjoint residue
+            # slice, so no tweet trains twice (r5; was a SystemExit)
+            return _wrap_faults(
+                IdShardedSource(
+                    source, jax.process_index(), jax.process_count()
+                ),
+                conf,
+            )
     else:
         raise SystemExit(f"unknown --source {conf.source!r}")
     if multihost:
@@ -255,6 +281,26 @@ def build_model(conf, model_cls=StreamingLinearRegressionWithSGD):
     return model_cls.from_conf(conf), 1
 
 
+def state_checksum(state) -> str:
+    """CRC of a checkpointable state (flat dict or one array) — logged at
+    recycle-save and at restore so a recycled run's logs PROVE the
+    post-restart weights are bit-identical to the pre-exec save
+    (tests/test_recycler.py asserts the two lines match)."""
+    import zlib
+
+    import numpy as np
+
+    arrs = state if isinstance(state, dict) else {"state": state}
+    crc = 0
+    for key in sorted(arrs):
+        a = np.ascontiguousarray(np.asarray(arrs[key]))
+        crc = zlib.crc32(
+            a.tobytes(),
+            zlib.crc32(f"{key}:{a.dtype}:{a.shape}".encode(), crc),
+        )
+    return f"{crc:08x}"
+
+
 class AppCheckpoint:
     """``--checkpointDir``/``--checkpointEvery`` wiring shared by every entry
     point (model checkpoint/resume is this framework's upgrade over the
@@ -293,8 +339,8 @@ class AppCheckpoint:
             totals["count"] = int(meta.get("count", 0))
             totals["batches"] = int(meta.get("batches", 0))
             log.info(
-                "resumed from checkpoint step %s (count=%s)",
-                meta.get("step"), totals["count"],
+                "resumed from checkpoint step %s (count=%s, state crc %s)",
+                meta.get("step"), totals["count"], state_checksum(state),
             )
         import jax
 
@@ -346,6 +392,126 @@ class AppCheckpoint:
         if self._ckpt is not None and totals["batches"] != self._last:
             self._save(totals)
 
+    def save_now(self, totals: dict) -> bool:
+        """Unconditional save (the recycler's pre-exec snapshot). Returns
+        False when no checkpoint dir is configured."""
+        if self._ckpt is None:
+            return False
+        self._save(totals)
+        return True
+
+
+class ProcessRecycler:
+    """``--recycleAfterMb``: bounded process lifetime as a MECHANISM, not
+    just a diagnosis (VERDICT r4 #7 — the RSS watchdog warns about the
+    axon-client transfer-buffer retention but could not act). When process
+    RSS crosses the configured ABSOLUTE ceiling, the next weights-current
+    batch boundary checkpoints and re-execs the process in place
+    (``os.execv`` — same interpreter, same argv, same environment).
+    Restore is exact (``AppCheckpoint``: weights + counters resume
+    bit-identically), so the recycle is invisible to the learning
+    trajectory; a live source simply reconnects and continues, while a
+    replay source restarts its file exactly as a manual
+    checkpoint-restart would (the flag targets long-lived LIVE/tunnel
+    deployments — the regime the retention affects).
+
+    Refused multi-host (one host exec'ing would desert the lockstep group;
+    recycle the whole group externally) and without ``--checkpointDir``
+    (nothing to resume from). ``TWTML_RECYCLE_MAX`` caps recycles per
+    process lineage (the count rides the ``TWTML_RECYCLES`` env var across
+    execs); unbounded by default."""
+
+    def __init__(self, conf, ckpt: AppCheckpoint, totals: dict,
+                 sample_every: int = 1):
+        import os as _os
+
+        self.threshold = float(getattr(conf, "recycleAfterMb", 0) or 0)
+        self._ticks = 0
+        # sample on every boundary by default: rss_mb is a ~µs statm read
+        # and boundaries are already sparse in back-to-back mode (the
+        # attach_super_batcher cadence); TWTML_RECYCLE_SAMPLE_EVERY remains
+        # the test hook pinning WHICH boundary recycles
+        self._sample_every = max(
+            1,
+            int(_os.environ.get("TWTML_RECYCLE_SAMPLE_EVERY", sample_every)),
+        )
+        if self.threshold <= 0:
+            return
+        import jax
+
+        if jax.process_count() > 1:
+            raise SystemExit(
+                "--recycleAfterMb is single-host: a multi-host lockstep "
+                "group cannot lose a member mid-collective — recycle the "
+                "whole group externally on the RSS watchdog's warning"
+            )
+        if not getattr(conf, "checkpointDir", ""):
+            raise SystemExit(
+                "--recycleAfterMb needs --checkpointDir (a recycle is "
+                "checkpoint + re-exec; without a checkpoint the restart "
+                "would train from zeros)"
+            )
+        self._ckpt = ckpt
+        self._totals = totals
+        self._lineage = int(_os.environ.get("TWTML_RECYCLES", "0") or 0)
+        self._max = int(_os.environ.get("TWTML_RECYCLE_MAX", "0") or 0)
+        self._capped_warned = False
+
+    def check(self, at_boundary: bool = True) -> None:
+        """Call per batch from the app handler, AFTER the cadence
+        checkpoint logic. Samples RSS every ``sample_every`` ticks; only a
+        weights-current boundary may recycle (the snapshot must include
+        this batch)."""
+        if self.threshold <= 0 or not at_boundary:
+            return
+        self._ticks += 1
+        if self._ticks % self._sample_every:
+            return
+        from ..utils.rss import rss_mb
+
+        cur = rss_mb()
+        if cur < self.threshold:
+            return
+        if self._max and self._lineage >= self._max:
+            if not self._capped_warned:
+                self._capped_warned = True
+                log.warning(
+                    "RSS %.0f MB over the --recycleAfterMb ceiling but "
+                    "TWTML_RECYCLE_MAX=%d reached; running on", cur, self._max,
+                )
+            return
+        self._recycle(cur)
+
+    def _recycle(self, cur_mb: float) -> None:
+        import os as _os
+        import sys as _sys
+
+        self._ckpt.save_now(self._totals)
+        main = _sys.modules.get("__main__")
+        spec = getattr(main, "__spec__", None)
+        if spec is not None and spec.name:
+            argv = [_sys.executable, "-m", spec.name] + _sys.argv[1:]
+        else:
+            argv = [_sys.executable] + _sys.argv
+        log.warning(
+            "process RSS %.0f MB crossed --recycleAfterMb %.0f: "
+            "checkpointed at batch %d (count=%d, state crc %s) and "
+            "re-exec'ing (recycle #%d of this lineage). Resume is exact.",
+            cur_mb, self.threshold, self._totals["batches"],
+            self._totals["count"],
+            state_checksum(self._ckpt._get_state()),
+            self._lineage + 1,
+        )
+        _os.environ["TWTML_RECYCLES"] = str(self._lineage + 1)
+        for h in list(log.handlers) or []:
+            try:
+                h.flush()
+            except Exception:
+                pass
+        _sys.stdout.flush()
+        _sys.stderr.flush()
+        _os.execv(_sys.executable, argv)
+
 
 class SuperBatcher:
     """Group K featurized micro-batches into ONE device dispatch
@@ -377,10 +543,19 @@ class SuperBatcher:
     batch that overflowed a pinned bucket, or flipped the units wire dtype,
     closes the pending group first and starts its own — it is never
     silently dropped, and partial groups run as plain steps (identical
-    math, no one-off scan compiles at odd lengths)."""
+    math, no one-off scan compiles at odd lengths). The ragged wire groups
+    too (r5): its data-dependent units bucket is part of the shape
+    signature, so only same-bucket batches share a scan program (totals
+    concentrate tightly — steady state is one or two buckets).
+
+    ``deterministic`` (multi-host mode) disables the opportunistic
+    already-done early emit, exactly like FetchPipeline's: handler side
+    effects then fire only at points driven by the dispatch counter, which
+    advances identically on every lockstep host."""
 
     def __init__(self, model, k: int, handle, fetch_depth: int = 4,
-                 boundary_every: int = 0, max_dispatch: int = 0):
+                 boundary_every: int = 0, max_dispatch: int = 0,
+                 deterministic: bool = False):
         from concurrent.futures import ThreadPoolExecutor
 
         self.model = model
@@ -388,11 +563,17 @@ class SuperBatcher:
         self.handle = handle
         self.fetch_depth = max(1, fetch_depth)
         self.max_dispatch = max_dispatch
+        self.deterministic = deterministic
         # cadence drains count DISPATCHED BATCHES (partial groups included),
         # honoring the pre-r3 contract: the first boundary at/after each
         # cadence point
         self.boundary_every = boundary_every
         self._last_boundary = 0
+        # model-aware host transfers (MultiHostSGDModel localizes the
+        # lead's predictions inside the pooled fetch); plain models use
+        # jax.device_get
+        self._fetch_many = getattr(model, "fetch_output_many", None)
+        self._fetch_one = getattr(model, "fetch_output", None)
         self._pool = ThreadPoolExecutor(
             max_workers=self.fetch_depth,
             thread_name_prefix="twtml-group-fetch",
@@ -404,7 +585,14 @@ class SuperBatcher:
 
     @staticmethod
     def _signature(batch):
-        return (type(batch),) + tuple((a.shape, a.dtype) for a in batch)
+        # tree_flatten, not tuple(batch): the ragged wire's batch is not a
+        # NamedTuple, and its static aux (row_len, shard alignment) must be
+        # part of the one-compiled-program signature — the treedef carries
+        # both the class and the aux
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(batch)
+        return (treedef,) + tuple((a.shape, str(a.dtype)) for a in leaves)
 
     def on_batch(self, batch, batch_time) -> None:
         if self.max_dispatch and self._dispatched >= self.max_dispatch:
@@ -431,9 +619,19 @@ class SuperBatcher:
         boundary_ok = not self._inflight
         for k, (batch, t) in enumerate(group):
             self.handle(
-                StepOutput(*(f[k] for f in host)), batch, t,
+                # a multi-host follower's predictions field is None (the
+                # lead owns per-row telemetry) — pass None through
+                StepOutput(*(
+                    None if f is None else f[k] for f in host
+                )),
+                batch, t,
                 at_boundary=(k == last and boundary_ok),
             )
+
+    def refund_dispatch(self) -> None:
+        """Give back one ``max_dispatch`` slot (multi-host globally-empty
+        batches — see FetchPipeline.refund_dispatch)."""
+        self._dispatched -= 1
 
     def _drain(self) -> None:
         while self._inflight:
@@ -456,18 +654,23 @@ class SuperBatcher:
             for batch, t in group:
                 if self.max_dispatch and self._dispatched >= self.max_dispatch:
                     return
-                out = jax.device_get(self.model.step(batch))
+                fetch = self._fetch_one or jax.device_get
+                out = fetch(self.model.step(batch))
                 self._dispatched += 1
                 self.handle(out, batch, t, at_boundary=True)
             return
-        # backpressure + timeliness, as in FetchPipeline
+        # backpressure + timeliness, as in FetchPipeline (the already-done
+        # probe is wall-clock-dependent, so deterministic/multi-host mode
+        # skips it — emits then happen only at counter-driven points)
         while len(self._inflight) >= self.fetch_depth or (
-            self._inflight and self._inflight[0][0].done()
+            not self.deterministic
+            and self._inflight and self._inflight[0][0].done()
         ):
             self._emit_group()
         outs = self.model.step_many(stack_batches([b for b, _ in group]))
         self._inflight.append(
-            (self._pool.submit(jax.device_get, outs), group)
+            (self._pool.submit(self._fetch_many or jax.device_get, outs),
+             group)
         )
         self._dispatched += len(group)
         if self.boundary_every and (
@@ -525,11 +728,17 @@ class FetchPipeline:
         self.model = model
         self.handle = handle
         self.depth = max(1, depth)
-        # one-buffer wire (features/batch.pack_batch): measured +11.4%
-        # paired on the ragged wire through this transport (per-ARRAY
-        # request overhead stops hiding once the wire is lean); handlers
-        # still receive the UNPACKED batch
+        # one-buffer wire: measured +11.4% paired on the ragged wire
+        # through this transport (per-ARRAY request overhead stops hiding
+        # once the wire is lean); handlers still receive the UNPACKED
+        # batch. The pack itself is model-aware (r5): mesh models lay the
+        # buffer out PER SHARD so the data axis can shard it
+        # (ParallelSGDModel.pack_for_wire), multi-host models additionally
+        # assemble the global buffer from every host's local shard segments
+        # (MultiHostSGDModel.pack_for_wire); plain models use the
+        # field-major features/batch.pack_batch
         self.pack = pack
+        self._packer = getattr(model, "pack_for_wire", None)
         self.deterministic = deterministic
         self._stop_requested = stop_requested
         self.boundary_every = boundary_every
@@ -585,7 +794,8 @@ class FetchPipeline:
         if self.pack:
             from ..features.batch import pack_batch
 
-            out = self.model.step(pack_batch(batch))  # MAIN-thread dispatch
+            packer = self._packer or pack_batch
+            out = self.model.step(packer(batch))  # MAIN-thread dispatch
         else:
             out = self.model.step(batch)  # dispatch on the MAIN thread
         self._pending.append(
@@ -661,16 +871,6 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
             "would delay live stats by %d intervals", k, conf.seconds, k,
         )
         k = 1
-    if k > 1 and multihost:
-        log.warning(
-            "--superBatch %d ignored: not wired for multi-host runs", k
-        )
-        k = 1
-    if k > 1 and getattr(stream, "ragged", False):
-        raise SystemExit(
-            "--superBatch is not wired for --wire ragged (ragged buffers "
-            "don't stack); use --wire padded"
-        )
     if k > 1 and (stream.row_bucket <= 0 or stream.token_bucket <= 0):
         raise ValueError(
             "--superBatch needs pinned shapes: set --batchBucket and "
@@ -723,12 +923,19 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
         if getattr(conf, "checkpointDir", "")
         else 0
     )
+    if int(getattr(conf, "recycleAfterMb", 0) or 0) > 0 and not boundary_every:
+        # --recycleAfterMb can only act at weights-current boundaries; in
+        # back-to-back mode with no --checkpointEvery the pipeline would
+        # otherwise never drain mid-stream and the flag would be silently
+        # inert (r5 review) — impose a default recycle-check cadence
+        boundary_every = 64
 
     # the ragged wire additionally ships as ONE packed buffer (measured
     # +11.4% paired — per-array request overhead stops hiding once the
-    # wire is lean; bit-identical unpack inside the jit step). Sharded
-    # models take the ragged batch directly instead (a packed buffer has
-    # no row sharding; ParallelSGDModel.step shard-aligns it).
+    # wire is lean; bit-identical unpack inside the jit step). Since r5
+    # every layout packs: mesh models lay the buffer out per shard and
+    # multi-host models assemble it globally (pack_for_wire), so the fast
+    # path survives every deployment shape.
     pack = bool(getattr(stream, "ragged", False)) and getattr(
         model, "accepts_packed", False
     )
@@ -762,7 +969,9 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
             if pack:
                 from ..features.batch import pack_batch
 
-                wire = pack_batch(batch)
+                wire = (getattr(model, "pack_for_wire", None) or pack_batch)(
+                    batch
+                )
             else:
                 wire = batch
             out = model.step(wire)
@@ -777,8 +986,21 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
         model, k, handle,
         boundary_every=boundary_every,
         max_dispatch=max_dispatch,
+        deterministic=multihost,
     )
-    stream.foreach_batch(skip_empty(batcher.on_batch))
+    if multihost:
+        pipeline_ref.append(batcher)  # empty-batch refunds (above)
+    # grouping needs every batch in its FINAL layout before the shape
+    # signature/stacking: mesh and multi-host models shard-align ragged
+    # batches (and harmonize the wire dtype across hosts) in prepare()
+    prepare = getattr(model, "prepare", None)
+    if prepare is None:
+        on_batch = batcher.on_batch
+    else:
+        def on_batch(batch, t):
+            batcher.on_batch(prepare(batch), t)
+
+    stream.foreach_batch(skip_empty(on_batch))
     return batcher.flush, k
 
 

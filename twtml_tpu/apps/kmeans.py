@@ -35,6 +35,7 @@ from ..telemetry.lightning import CHART_MAX_POINTS, Lightning
 from ..utils import get_logger
 from .common import (
     AppCheckpoint,
+    ProcessRecycler,
     build_mesh,
     build_source,
     init_distributed,
@@ -144,6 +145,7 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
         totals=totals,
         lead=lead,
     )
+    recycler = ProcessRecycler(conf, ckpt, totals)
 
     # multi-host: the fixed per-host row shape (lockstep drains cap at it)
     local_bucket = (
@@ -229,6 +231,7 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
             except queue.Full:
                 pass
         ckpt.maybe_save(totals)
+        recycler.check()
         if max_batches and totals["batches"] >= max_batches:
             ssc.request_stop()
 
@@ -286,6 +289,7 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
         except queue.Full:
             pass
         ckpt.maybe_save(totals)
+        recycler.check()
         if max_batches and totals["batches"] >= max_batches:
             ssc.request_stop()
 

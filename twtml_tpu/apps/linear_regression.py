@@ -27,6 +27,7 @@ from ..utils import get_logger, round_half_up
 # flagship entry other modules historically import the helpers from
 from .common import (  # noqa: F401
     AppCheckpoint,
+    ProcessRecycler,
     attach_super_batcher,
     build_model,
     build_source,
@@ -62,7 +63,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         row_bucket=conf.batchBucket, token_bucket=conf.tokenBucket,
         row_multiple=row_multiple,
         device_hash=conf.hashOn == "device",
-        ragged=conf.wire == "ragged",
+        ragged=conf.effective_wire() == "ragged",
     )
 
     totals = {"count": 0, "batches": 0}
@@ -75,6 +76,11 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         totals=totals,
         lead=lead,
     )
+
+    # --recycleAfterMb: bounded process lifetime (checkpoint + exact-resume
+    # re-exec) once RSS crosses the ceiling — the actionable form of the
+    # RSS watchdog's diagnosis (apps/common.ProcessRecycler)
+    recycler = ProcessRecycler(conf, ckpt, totals)
 
     from ..utils.tracing import Tracer
 
@@ -105,6 +111,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
                 totals["count"], b, mse, real_stdev, pred_stdev, real, pred
             )
         ckpt.maybe_save(totals, at_boundary)
+        recycler.check(at_boundary)
         if max_batches and totals["batches"] >= max_batches:
             ssc.request_stop()
 

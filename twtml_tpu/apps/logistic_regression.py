@@ -30,6 +30,7 @@ from ..telemetry.session_stats import SessionStats
 from ..utils import get_logger, round_half_up
 from .common import (
     AppCheckpoint,
+    ProcessRecycler,
     attach_super_batcher,
     build_model,
     build_source,
@@ -63,7 +64,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         row_bucket=conf.batchBucket, token_bucket=conf.tokenBucket,
         row_multiple=row_multiple,
         device_hash=conf.hashOn == "device",
-        ragged=conf.wire == "ragged",
+        ragged=conf.effective_wire() == "ragged",
     )
     totals = {"count": 0, "batches": 0}
 
@@ -75,6 +76,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         totals=totals,
         lead=lead,
     )
+    recycler = ProcessRecycler(conf, ckpt, totals)
 
     def handle(out, batch, _batch_time, at_boundary=True) -> None:
         b = int(out.count)
@@ -105,6 +107,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
                 real, pred,
             )
         ckpt.maybe_save(totals, at_boundary)
+        recycler.check(at_boundary)
         if max_batches and totals["batches"] >= max_batches:
             ssc.request_stop()
 

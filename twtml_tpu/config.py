@@ -126,10 +126,10 @@ class ConfArguments:
             raise ValueError(
                 f"ingest must be 'object' or 'block', got {self.ingest!r}"
             )
-        self.wire: str = conf.get("wire", "padded")
-        if self.wire not in ("padded", "ragged"):
+        self.wire: str = conf.get("wire", "auto")
+        if self.wire not in ("auto", "padded", "ragged"):
             raise ValueError(
-                f"wire must be 'padded' or 'ragged', got {self.wire!r}"
+                f"wire must be 'auto', 'padded' or 'ragged', got {self.wire!r}"
             )
         self.l2Reg: float = float(conf.get("l2Reg", "0.0"))
         self.convergenceTol: float = float(conf.get("convergenceTol", "0.001"))
@@ -139,6 +139,7 @@ class ConfArguments:
         self.profileDir: str = conf.get("profileDir", "")
         self.faultEvery: int = int(conf.get("faultEvery", "0"))
         self.superBatch: int = int(conf.get("superBatch", "1"))
+        self.recycleAfterMb: int = int(conf.get("recycleAfterMb", "0"))
 
         # Multi-host process group (the reference's one-flag cluster story,
         # ConfArguments.scala:95-98 --master spark://host:port): here a
@@ -219,11 +220,14 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
   --ingest <object|block>                      Replay ingestion: per-tweet Status objects, or
                                                columnar blocks via the native C parser (~10x
                                                ingest throughput; replay source only). Default: {self.ingest}
-  --wire <padded|ragged>                       Units wire format (hashOn=device): padded [B, L]
-                                               buffer, or ragged concatenated units + offsets
-                                               (no pad bytes on the upload-bound transport;
-                                               single-device, object ingest, no superbatch).
-                                               Default: {self.wire}
+  --wire <auto|padded|ragged>                  Units wire format: ragged ships concatenated
+                                               units + offsets (no pad bytes; the measured-
+                                               fastest wire on every layout — packed, sharded,
+                                               superbatched), padded ships a [B, L] buffer.
+                                               auto = ragged for hashOn=device back-to-back
+                                               runs (--seconds 0); padded for wall-clock
+                                               streaming (pre-compilable before the stream
+                                               starts) and host hashing. Default: {self.wire}
   --l2Reg <float>                              L2 regularization. Default: {self.l2Reg}
   --convergenceTol <float>                     SGD convergence tolerance. Default: {self.convergenceTol}
   --dtype <float32|bfloat16|float64>           Device dtype. Default: {self.dtype}
@@ -231,6 +235,12 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
   --checkpointEvery <int batches>              Checkpoint cadence. Default: {self.checkpointEvery}
   --profileDir <path>                          Enable jax.profiler traces
   --faultEvery <int tweets>                    Inject a receiver crash every N tweets (chaos testing)
+  --recycleAfterMb <int MB>                    Bounded process lifetime: checkpoint at the next
+                                               batch boundary and re-exec in place once process
+                                               RSS crosses this ceiling (needs --checkpointDir;
+                                               single-host; resume is exact). 0 = off. Made for
+                                               the known tunnel-client RSS retention — see
+                                               BENCHMARKS.md "Endurance soaks"
   --superBatch <int>                           Replay-mode superbatch: K micro-batches per device
                                                dispatch (one scan, one stats fetch; per-batch
                                                stats preserved; stops/checkpoints land on group
@@ -306,7 +316,7 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                 self.printUsage(1)
         elif flag == "--wire":
             self.wire = take()
-            if self.wire not in ("padded", "ragged"):
+            if self.wire not in ("auto", "padded", "ragged"):
                 self.printUsage(1)
         elif flag == "--l2Reg":
             self.l2Reg = float(take())
@@ -322,6 +332,8 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
             self.profileDir = take()
         elif flag == "--superBatch":
             self.superBatch = int(take())
+        elif flag == "--recycleAfterMb":
+            self.recycleAfterMb = int(take())
         elif flag == "--faultEvery":
             self.faultEvery = int(take())
         elif flag in ("--help", "-h"):
@@ -335,6 +347,26 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
         raise SystemExit(exit_code)
 
     # -- derived ------------------------------------------------------------
+    def effective_wire(self) -> str:
+        """Resolve ``--wire auto`` (the default) to the measured-best wire
+        for this configuration: RAGGED whenever the device hashes in a
+        back-to-back regime (the headline/bench path — +14% paired on
+        object ingest, +28% from blocks, packed for another +11.4%,
+        sharded on every layout since r4/r5); PADDED for host hashing (the
+        ragged wire ships raw code units by definition) and for WALL-CLOCK
+        streaming (--seconds > 0): the ragged units bucket is
+        data-dependent, so it cannot pre-compile before the stream starts
+        (apps/common.warmup_compile) — a live run would stall ~30 s on its
+        first batch — while wall-clock intervals are latency-dominated and
+        wire bytes don't bind there. Explicit ``--wire ragged``/``padded``
+        always wins; explicit ragged with --hashOn host is rejected at
+        source construction (apps/common.build_source)."""
+        if self.wire != "auto":
+            return self.wire
+        if self.hashOn != "device" or self.seconds > 0:
+            return "padded"
+        return "ragged"
+
     def local_shards(self) -> int | None:
         """Parse Spark-style local[N] master hints; None means use all devices."""
         m = self.master

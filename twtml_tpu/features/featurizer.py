@@ -161,6 +161,10 @@ class Status:
     created_at_ms: int = 0
     retweeted_status: "Status | None" = None
     lang: str = ""
+    # the tweet's snowflake id (getId) — the live multi-host intake shard
+    # key (streaming/sources.IdShardedSource); 0 when absent (synthetic/
+    # replay fixtures without ids)
+    id: int = 0
 
     @property
     def is_retweet(self) -> bool:
@@ -183,6 +187,7 @@ class Status:
             ),
             retweeted_status=cls.from_json(rs) if rs else None,
             lang=obj.get("lang") or "",
+            id=int(obj.get("id") or 0),
         )
 
 
@@ -209,11 +214,21 @@ class Featurizer:
     @classmethod
     def from_conf(cls, conf) -> "Featurizer":
         """Equivalent of MllibHelper.reset(conf) (MllibHelper.scala:22-32),
-        except the knobs actually take effect (see module docstring)."""
+        except the knobs actually take effect (see module docstring).
+
+        ``TWTML_NOW_MS`` (env) pins the age-feature clock — the
+        deterministic-replay hook app-level differential tests use to
+        compare a real app run against a library-built ground truth (the
+        age feature otherwise reads the wall clock, as the reference's
+        ``new Date()`` does — MllibHelper.scala:73)."""
+        import os as _os
+
+        now_env = _os.environ.get("TWTML_NOW_MS", "")
         return cls(
             num_text_features=conf.numTextFeatures,
             num_retweet_begin=conf.numRetweetBegin,
             num_retweet_end=conf.numRetweetEnd,
+            now_ms=int(now_env) if now_env else None,
         )
 
     @property

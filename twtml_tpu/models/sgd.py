@@ -554,18 +554,24 @@ class StreamingSGDModel:
         """Fused predict-then-train on one micro-batch; advances the model.
 
         Accepts the one-buffer wire format too (``pack_batch``) — bit-
-        identical unpack inside the jit step. NOT applied by default: on
-        this build's transport the multi-array overhead hides behind
-        overlapped dispatch in every real regime (measured — BENCHMARKS.md
-        "negative results"), so packing is an explicit opt-in for transports
-        where per-transfer cost is exposed."""
+        identical unpack inside the jit step. On the lean RAGGED wire the
+        packed form is the shipped default (+11.4% paired, r3 — per-array
+        request overhead stops hiding once the wire is lean; the app paths
+        pack via the fetch pipeline, apps/common.py); on the padded wire it
+        stays an opt-in (measured neutral there — BENCHMARKS.md)."""
         self._weights, out = self._step(self._weights, batch)
         return out
 
-    def step_many(self, stacked: FeatureBatch | UnitBatch) -> StepOutput:
+    def step_many(
+        self, stacked: FeatureBatch | UnitBatch | RaggedUnitBatch | PackedBatch
+    ) -> StepOutput:
         """K micro-batch steps as ONE dispatch — ``lax.scan`` over a stacked
         batch (every array carries a leading [K] axis; ``stack_batches``
-        builds one from K same-shape batches).
+        builds one from K same-shape batches, the ragged wire included —
+        its [K, N] units buffer scans like any leaf, with row_len static).
+        A stacked batch may also arrive PACKED (``pack_batch`` of the
+        stacked pytree): the scan program unpacks it in-place first, same
+        bitcast contract as ``step``.
 
         The scan body IS ``step``'s program and the weights chain through it
         exactly as K sequential ``step`` calls would — identical final
@@ -579,8 +585,10 @@ class StreamingSGDModel:
         if self._scan_step is None:
             inner = self._train_step
 
-            def scanned(weights, stacked_batch):
-                return lax.scan(inner, weights, stacked_batch)
+            def scanned(weights, wire):
+                if isinstance(wire, PackedBatch):
+                    wire = unpack_batch(wire.buffer, wire.layout)
+                return lax.scan(inner, weights, wire)
 
             self._scan_step = jax.jit(scanned, donate_argnums=0)
         self._weights, outs = self._scan_step(self._weights, stacked)

@@ -89,6 +89,36 @@ def host_local_rows_to_global(arr: np.ndarray, mesh):
     )
 
 
+def _ragged_local_aligned(batch: RaggedUnitBatch, mesh) -> RaggedUnitBatch:
+    """uint16-harmonized, aligned-to-LOCAL-shards ragged batch with the
+    per-shard sub-buffer capacity AGREED across processes by one tiny
+    allgather-max — the one alignment rule every multi-host ragged path
+    shares (global assembly, per-shard packing, and group preparation), so
+    every host compiles identical program shapes. Callers must invoke it at
+    deterministic points (the lockstep tick / dispatch path) so the
+    collective always pairs."""
+    import numpy as _np
+    from jax.experimental import multihost_utils
+
+    from ..features.batch import align_ragged_shards, ragged_shard_bucket
+
+    if batch.units.dtype != _np.uint16:
+        batch = RaggedUnitBatch(
+            _np.asarray(batch.units, _np.uint16), batch.offsets,
+            batch.numeric, batch.label, batch.mask,
+            row_len=batch.row_len, num_shards=batch.num_shards,
+        )
+    num_data = mesh.shape[mesh.axis_names[0]]
+    local_shards = num_data // jax.process_count()
+    need = ragged_shard_bucket(batch, local_shards)
+    agreed = int(
+        multihost_utils.process_allgather(
+            _np.array([need], _np.int64)
+        ).max()
+    )
+    return align_ragged_shards(batch, local_shards, unit_bucket=agreed)
+
+
 class MultiHostSGDModel:
     """Per-host sharded intake over a multi-process mesh, with the same step
     surface the apps consume (apps/common.build_model): LOCAL host batches
@@ -121,15 +151,137 @@ class MultiHostSGDModel:
     # the module-level helper, kept as a method name for call sites
     _local_rows = staticmethod(local_rows)
 
+    # the ragged wire packs per shard on multi-host too (pack_for_wire);
+    # the app-side pack opt-in keys off this (apps/common.py)
+    accepts_packed = True
+
     def step(self, local_batch):
         """Dispatch only — returns the StepOutput with predictions still
         GLOBAL (row-sharded). Localization + host transfer live in
         ``fetch_output`` so the main thread never blocks a transport round
         trip at dispatch time (r3 advisor: the synchronous lead-side
         ``local_rows`` here re-introduced exactly the per-batch sync the
-        FetchPipeline exists to remove)."""
+        FetchPipeline exists to remove). A PackedBatch from
+        ``pack_for_wire`` is already the assembled global wire — pass it
+        straight to the mesh step."""
+        from ..features.batch import PackedBatch
+
+        if isinstance(local_batch, PackedBatch):
+            return self.inner.step(local_batch)
         return self.inner.step(
             host_local_batch_to_global(local_batch, self.mesh)
+        )
+
+    def prepare(self, batch):
+        """Pre-group hook (SuperBatcher calls it per batch BEFORE shape
+        signatures/stacking): harmonize the units wire dtype across hosts
+        and shard-align ragged batches to this host's local shards with the
+        cross-process agreed bucket — so every host's group signatures,
+        closure ticks, and stacked shapes are identical (the lockstep
+        contract extended to groups). Runs at the scheduler tick, a
+        deterministic point, so the agree collective always pairs."""
+        if isinstance(batch, RaggedUnitBatch):
+            return _ragged_local_aligned(batch, self.mesh)
+        if isinstance(batch, UnitBatch) and batch.units.dtype != np.uint16:
+            return batch._replace(units=batch.units.astype(np.uint16))
+        return batch
+
+    def pack_for_wire(self, local_batch):
+        """The multi-host form of the one-buffer ragged wire: align this
+        host's rows to its LOCAL shard segments (agreed bucket — uniform
+        per-segment bytes on every host), pack them, and assemble the
+        global per-shard buffer from every process's contribution."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..features.batch import PackedBatch, pack_ragged_sharded
+
+        if not isinstance(local_batch, RaggedUnitBatch):
+            raise TypeError(
+                "pack_for_wire is the ragged wire's pack; padded batches "
+                "assemble as plain arrays"
+            )
+        aligned = _ragged_local_aligned(local_batch, self.mesh)
+        pb = pack_ragged_sharded(aligned, num_shards_out=self.num_data)
+        sharding = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+        buf = jax.make_array_from_process_local_data(
+            sharding, pb.buffer,
+            (pb.buffer.shape[0] * jax.process_count(),),
+        )
+        return PackedBatch(buf, pb.layout)
+
+    def step_many(self, stacked):
+        """K-batch group over the multi-host mesh: the app pre-aligns and
+        harmonizes each LOCAL batch (``prepare``), the SuperBatcher stacks
+        K of them, and this assembles ONE global stacked batch ([K, ...]
+        leaves, rows sharded on axis 1) for the mesh scan — one dispatch
+        and one pooled stats fetch per K batches, multi-host included."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .sharding import _pspecs_for, _stacked
+
+        data_axis = self.mesh.axis_names[0]
+
+        def to_global(host_arr, spec):
+            host_arr = np.asarray(host_arr)
+            global_shape = (
+                host_arr.shape[0],
+                host_arr.shape[1] * jax.process_count(),
+            ) + host_arr.shape[2:]
+            return jax.make_array_from_process_local_data(
+                NamedSharding(self.mesh, spec), host_arr, global_shape
+            )
+
+        if isinstance(stacked, RaggedUnitBatch):
+            local_shards = self.num_data // jax.process_count()
+            if stacked.num_shards != local_shards:
+                raise ValueError(
+                    "stack prepare()-aligned batches (per-host local "
+                    "shard segments)"
+                )
+            spec = P(None, data_axis)
+            stacked = RaggedUnitBatch(
+                *(to_global(a, spec) for a in (
+                    stacked.units, stacked.offsets, stacked.numeric,
+                    stacked.label, stacked.mask,
+                )),
+                row_len=stacked.row_len,
+                num_shards=self.num_data,
+            )
+            return self.inner.step_many(stacked)
+        specs = _stacked(_pspecs_for(type(stacked), data_axis))
+        return self.inner.step_many(
+            type(stacked)(*(
+                to_global(a, s) for a, s in zip(stacked, specs)
+            ))
+        )
+
+    def fetch_output_many(self, outs):
+        """The group form of ``fetch_output``: [K]-vector global stats for
+        every host; the lead localizes its own rows' predictions for each
+        of the K batches ([K, B_local], shards sorted by their ROW offset —
+        the row axis is axis 1 of a stacked output)."""
+        from ..models.base import StepOutput
+
+        count, mse, real_stdev, pred_stdev = jax.device_get(
+            (outs.count, outs.mse, outs.real_stdev, outs.pred_stdev)
+        )
+        preds = None
+        if self._lead:
+            shards = sorted(
+                outs.predictions.addressable_shards,
+                key=lambda s: s.index[1].start or 0,
+            )
+            for s in shards:
+                s.data.copy_to_host_async()
+            preds = np.concatenate(
+                [np.asarray(s.data) for s in shards], axis=1
+            )
+        return StepOutput(
+            predictions=preds,
+            count=count,
+            mse=mse,
+            real_stdev=real_stdev,
+            pred_stdev=pred_stdev,
         )
 
     def fetch_output(self, out):
@@ -152,11 +304,6 @@ class MultiHostSGDModel:
             mse=mse,
             real_stdev=real_stdev,
             pred_stdev=pred_stdev,
-        )
-
-    def step_many(self, stacked):
-        raise NotImplementedError(
-            "--superBatch is not wired for multi-host runs"
         )
 
 
@@ -203,26 +350,9 @@ def host_local_batch_to_global(
         )
 
     if isinstance(batch, RaggedUnitBatch):
-        from jax.experimental import multihost_utils
-
-        from ..features.batch import align_ragged_shards, ragged_shard_bucket
-
-        if batch.units.dtype != np.uint16:
-            batch = RaggedUnitBatch(
-                np.asarray(batch.units, np.uint16), batch.offsets,
-                batch.numeric, batch.label, batch.mask,
-                row_len=batch.row_len, num_shards=batch.num_shards,
-            )
         data_axis = mesh.axis_names[0]
         num_data = mesh.shape[data_axis]
-        local_shards = num_data // jax.process_count()
-        need = ragged_shard_bucket(batch, local_shards)
-        agreed = int(
-            multihost_utils.process_allgather(
-                np.array([need], np.int64)
-            ).max()
-        )
-        batch = align_ragged_shards(batch, local_shards, unit_bucket=agreed)
+        batch = _ragged_local_aligned(batch, mesh)
         spec = P(data_axis)
         return RaggedUnitBatch(
             *(to_global(a, spec) for a in (

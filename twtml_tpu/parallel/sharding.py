@@ -33,9 +33,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..features.batch import (
     NUM_NUMBER_FEATURES,
     FeatureBatch,
+    PackedBatch,
     RaggedUnitBatch,
     UnitBatch,
     align_ragged_shards,
+    pack_ragged_sharded,
+    unpack_batch,
 )
 from ..models.base import StepOutput
 from ..models.sgd import (
@@ -82,6 +85,10 @@ def _pspecs_for(batch_cls, data_axis: str):
         # segment-relative offsets, rows) shards its leading dim — the
         # shard-aligned layout makes them all divisible by the data axis
         return P(data_axis)
+    if batch_cls is PackedBatch:
+        # the per-shard packed buffer (pack_ragged_sharded): S equal shard
+        # segments, so P(data) hands each device exactly its rows' bytes
+        return P(data_axis)
     return (
         unit_batch_pspecs(data_axis)
         if batch_cls is UnitBatch
@@ -106,13 +113,22 @@ def shard_batch(batch: FeatureBatch | UnitBatch | RaggedUnitBatch, mesh):
     row axis the same way with K unsharded. A RaggedUnitBatch is
     shard-ALIGNED first (``align_ragged_shards`` — a host memcpy unless the
     featurizer already aligned it), after which every leaf row-shards over
-    ``data`` like the padded wire."""
+    ``data`` like the padded wire; a STACKED ragged batch must already be
+    aligned per batch (alignment is a flat-batch operation — the grouping
+    path aligns before stacking, apps/common.py)."""
     data_axis = mesh.axis_names[0]
     if isinstance(batch, RaggedUnitBatch):
         num_data = mesh.shape[data_axis]
+        stacked = batch.mask.ndim == 2
         if batch.num_shards != num_data:
+            if stacked:
+                raise ValueError(
+                    "stacked ragged batches must be shard-aligned per "
+                    "batch before stacking (model.prepare)"
+                )
             batch = align_ragged_shards(batch, num_data)
-        sharding = NamedSharding(mesh, P(data_axis))
+        spec = P(None, data_axis) if stacked else P(data_axis)
+        sharding = NamedSharding(mesh, spec)
         return RaggedUnitBatch(
             *(jax.device_put(a, sharding) for a in (
                 batch.units, batch.offsets, batch.numeric, batch.label,
@@ -401,8 +417,18 @@ class ParallelSGDModel:
     def _step_for(self, batch_cls) -> Callable:
         fn = self._sharded.get(batch_cls)
         if fn is None:
+            body = self._step_body
+            if batch_cls is PackedBatch:
+                # per-shard packed ragged wire: each device's local slice is
+                # ONE shard segment; rebuild the shard-local batch in-program
+                # (zero-copy bitcasts) and run the ordinary per-shard body
+                def body(weights, pb, _inner=self._step_body):
+                    return _inner(
+                        weights, unpack_batch(pb.buffer, pb.layout)
+                    )
+
             sharded = jax.shard_map(
-                self._step_body,
+                body,
                 mesh=self.mesh,
                 in_specs=(self._w_spec, _pspecs_for(batch_cls, self.data_axis)),
                 out_specs=self._out_specs,
@@ -504,26 +530,95 @@ class ParallelSGDModel:
                 f"mesh's data axis"
             )
 
-    def step(
-        self, batch: FeatureBatch | UnitBatch | RaggedUnitBatch
-    ) -> StepOutput:
-        self._check_rows(batch.mask.shape[0])
+    # the shard-aligned ragged wire also ships PACKED — one buffer laid out
+    # per shard (pack_ragged_sharded); the app-side pack opt-in keys off
+    # this capability (apps/common.py)
+    accepts_packed = True
+
+    def prepare(self, batch):
+        """Host-side shard alignment WITHOUT device placement — the
+        grouping paths (SuperBatcher) call this per batch so shape
+        signatures and stacking see the final shard-aligned layout (a
+        stacked batch cannot be re-aligned)."""
         if (
             isinstance(batch, RaggedUnitBatch)
             and batch.num_shards != self.num_data
         ):
-            # host ragged batch straight from a featurizer: re-lay into
-            # per-shard segments + place (a no-op for pre-aligned batches,
-            # e.g. the multi-host global assembly)
-            batch = shard_batch(batch, self.mesh)
+            return align_ragged_shards(batch, self.num_data)
+        return batch
+
+    def pack_for_wire(self, batch) -> PackedBatch:
+        """The mesh form of the one-buffer ragged wire: shard-align, then
+        pack per shard and place with row sharding (each device receives
+        exactly its shard segment's bytes)."""
+        if not isinstance(batch, RaggedUnitBatch):
+            raise TypeError(
+                "pack_for_wire is the ragged wire's mesh pack; padded "
+                "batches shard as plain arrays"
+            )
+        pb = pack_ragged_sharded(self.prepare(batch))
+        return PackedBatch(
+            jax.device_put(
+                pb.buffer, NamedSharding(self.mesh, P(self.data_axis))
+            ),
+            pb.layout,
+        )
+
+    def _packed_rows(self, pb: PackedBatch) -> int:
+        """Global row count recorded in a RaggedShardSegments layout."""
+        if pb.layout[0] != "RaggedShardSegments":
+            raise ValueError(
+                "mesh models take the per-shard packed layout "
+                "(pack_for_wire), not the flat pack_batch buffer"
+            )
+        s = pb.layout[2][1]
+        if s != self.num_data:
+            raise ValueError(
+                f"packed buffer is laid out for {s} shards; this mesh's "
+                f"data axis is {self.num_data}"
+            )
+        return pb.layout[1][4][0][0] * s  # per-shard mask rows × shards
+
+    def step(
+        self, batch: FeatureBatch | UnitBatch | RaggedUnitBatch | PackedBatch
+    ) -> StepOutput:
+        if isinstance(batch, PackedBatch):
+            self._check_rows(self._packed_rows(batch))
+            if not isinstance(batch.buffer, jax.Array):
+                batch = PackedBatch(
+                    jax.device_put(
+                        batch.buffer,
+                        NamedSharding(self.mesh, P(self.data_axis)),
+                    ),
+                    batch.layout,
+                )
+        else:
+            self._check_rows(batch.mask.shape[0])
+            if (
+                isinstance(batch, RaggedUnitBatch)
+                and batch.num_shards != self.num_data
+            ):
+                # host ragged batch straight from a featurizer: re-lay into
+                # per-shard segments + place (a no-op for pre-aligned
+                # batches, e.g. the multi-host global assembly)
+                batch = shard_batch(batch, self.mesh)
         self._weights, out = self._step_for(type(batch))(self._weights, batch)
         return out
 
-    def step_many(self, stacked: FeatureBatch | UnitBatch) -> StepOutput:
+    def step_many(
+        self, stacked: FeatureBatch | UnitBatch | RaggedUnitBatch
+    ) -> StepOutput:
         """K micro-batch steps as one dispatch over the mesh (superbatch:
         ``features.batch.stack_batches``); per-batch stats return along
-        axis 0. See ``_scan_for``."""
+        axis 0. Stacked ragged batches must be shard-aligned per batch
+        (``prepare`` before stacking) and are placed explicitly; already-
+        global arrays (multi-host assembly) pass through. See
+        ``_scan_for``."""
         self._check_rows(stacked.mask.shape[1])
+        if isinstance(stacked, RaggedUnitBatch) and not isinstance(
+            stacked.units, jax.Array
+        ):
+            stacked = shard_batch(stacked, self.mesh)
         self._weights, outs = self._scan_for(type(stacked))(
             self._weights, stacked
         )

@@ -58,6 +58,14 @@ class _RowCountQueue(queue.Queue):
         self.rows_queued -= getattr(item, "rows", 1)
         return item
 
+    def putback(self, item) -> None:
+        """Return an item to the FRONT of the queue (the drain splitter's
+        remainder — it must come out first so row order is preserved)."""
+        with self.mutex:
+            self.queue.appendleft(item)
+            self.rows_queued += getattr(item, "rows", 1)
+            self.not_empty.notify()
+
 
 class RawStream:
     """A stream of raw Status lists — for apps with their own featurization
@@ -258,8 +266,13 @@ class StreamingContext:
 
     def _drain(self, limit: int = 0) -> list[Status]:
         """Drain queued items; ``limit`` caps the drained ROW count (a
-        ParsedBlock item counts its rows, a Status counts 1 — one block can
-        overshoot the cap, exactly like it overshoots a pinned bucket)."""
+        ParsedBlock item counts its rows, a Status counts 1). A ParsedBlock
+        that would overshoot the cap is SPLIT at the cap (r5) and its
+        remainder put back at the queue front — capped drains are therefore
+        exactly ``limit`` rows while data lasts, which multi-host lockstep
+        requires (an overshooting block would grow this host's program
+        shape away from its peers') and which makes single-host
+        back-to-back block batches deterministic bucket-sized too."""
         out: list[Status] = []
         rows = 0
         while not limit or rows < limit:
@@ -267,8 +280,17 @@ class StreamingContext:
                 item = self._queue.get_nowait()
             except queue.Empty:
                 break
+            take = getattr(item, "rows", None)
+            if take is not None and limit and rows + take > limit:
+                from ..features.blocks import slice_block
+
+                cut = limit - rows
+                out.append(slice_block(item, 0, cut))
+                self._queue.putback(slice_block(item, cut, take))
+                rows = limit
+                break
             out.append(item)
-            rows += getattr(item, "rows", 1)
+            rows += take if take is not None else 1
         return out
 
     def _run_batch(self, statuses: list[Status], batch_time: float) -> None:

@@ -146,63 +146,31 @@ class ReplayFileSource(Source):
                 return
 
 
-class BlockReplayFileSource(Source):
-    """Replay a .jsonl file through the NATIVE data loader: each yielded
-    item is a columnar ParsedBlock (features/blocks.py) straight from the C
-    parser (native/tweetjson.cpp), with the isRetweet + retweet-interval
-    filter already applied — no per-tweet Python objects at all, an order of
-    magnitude faster than the json.loads path. Pure-Python fallback (the
-    ground truth) kicks in when the C library is unavailable. As-fast-as-
-    possible only (block ingest has no per-tweet pacing)."""
+class BlockParserMixin:
+    """The bytes → ParsedBlock stage both block sources share (file replay
+    below and the live ``BlockTwitterSource``, twitter.py): the native C
+    parser with the pure-Python ground-truth fallback. Consumers set
+    ``begin``/``end`` (the retweet-interval filter) and ``copy``."""
 
-    name = "replay-block"
+    begin: int
+    end: int
+    copy: bool = True
 
-    def __init__(
-        self,
-        path: str,
-        num_retweet_begin: int = 100,
-        num_retweet_end: int = 1000,
-        block_bytes: int = 1 << 20,
-        loop: bool = False,
-        copy: bool = True,
-        **kw,
-    ):
-        super().__init__(**kw)
-        self.path = path
-        self.begin = num_retweet_begin
-        self.end = num_retweet_end
-        self.block_bytes = block_bytes
-        self.loop = loop
-        # copy=False: blocks are views into per-call buffers (see
-        # native.parse_tweet_block) — for consumers that featurize each
-        # block promptly (the bench pipeline), not for accumulation
-        self.copy = copy
-
-    def produce(self) -> Iterator:
-        while True:
-            with open(self.path, "rb") as fh:
-                carry = b""
-                while True:
-                    chunk = fh.read(self.block_bytes)
-                    if not chunk:
-                        # drain the tail, looping in case a parse stops at a
-                        # capacity bound mid-buffer (carry keeps the rest)
-                        data = carry
-                        while data.strip():
-                            if not data.endswith(b"\n"):
-                                data += b"\n"
-                            block, rest = self._parse(data)
-                            if block is not None and block.rows:
-                                yield block
-                            if not rest or rest == data:
-                                break
-                            data = rest
-                        break
-                    block, carry = self._parse(carry + chunk)
-                    if block is not None and block.rows:
-                        yield block
-            if not self.loop:
-                return
+    def parse_buffer(self, data: bytes) -> "list":
+        """Parse a whole byte buffer (must end at a line boundary) into
+        ParsedBlocks, looping over the parser's capacity bounds so an
+        oversized buffer cannot drop its tail."""
+        blocks = []
+        while data.strip():
+            if not data.endswith(b"\n"):
+                data += b"\n"
+            block, rest = self._parse(data)
+            if block is not None and block.rows:
+                blocks.append(block)
+            if not rest or rest == data:
+                break
+            data = rest
+        return blocks
 
     def _parse(self, data: bytes):
         """(ParsedBlock | None, carry bytes) for one buffered chunk."""
@@ -297,6 +265,118 @@ class BlockReplayFileSource(Source):
         return block, carry
 
 
+
+
+class BlockReplayFileSource(BlockParserMixin, Source):
+    """Replay a .jsonl file through the NATIVE data loader: each yielded
+    item is a columnar ParsedBlock (features/blocks.py) straight from the C
+    parser (native/tweetjson.cpp), with the isRetweet + retweet-interval
+    filter already applied — no per-tweet Python objects at all, an order of
+    magnitude faster than the json.loads path. Pure-Python fallback (the
+    ground truth) kicks in when the C library is unavailable. As-fast-as-
+    possible only (block ingest has no per-tweet pacing).
+
+    ``shard_index``/``shard_count`` select a BYTE-RANGE shard of the file
+    (r5, multi-host block ingest — the Spark analog of shipping
+    deserialization to every executor, SURVEY.md §2.4 L0): the file's byte
+    span splits into ``shard_count`` equal ranges, and a line belongs to
+    the shard containing its FIRST byte, so each host reads AND parses only
+    ~1/N of the file with no coordination and no line read twice. Unlike
+    ``ShardedSource``'s per-item round robin this keeps each shard's IO
+    sequential — the point of the block loader."""
+
+    name = "replay-block"
+
+    def __init__(
+        self,
+        path: str,
+        num_retweet_begin: int = 100,
+        num_retweet_end: int = 1000,
+        block_bytes: int = 1 << 20,
+        loop: bool = False,
+        copy: bool = True,
+        shard_index: int = 0,
+        shard_count: int = 1,
+        **kw,
+    ):
+        super().__init__(**kw)
+        self.path = path
+        self.begin = num_retweet_begin
+        self.end = num_retweet_end
+        self.block_bytes = block_bytes
+        self.loop = loop
+        # copy=False: blocks are views into per-call buffers (see
+        # native.parse_tweet_block) — for consumers that featurize each
+        # block promptly (the bench pipeline), not for accumulation
+        self.copy = copy
+        if not 0 <= shard_index < max(1, shard_count):
+            raise ValueError(
+                f"shard index {shard_index} out of range for {shard_count}"
+            )
+        self.shard_index = shard_index
+        self.shard_count = max(1, shard_count)
+
+    def _shard_range(self) -> "tuple[int, int]":
+        """This shard's [start, stop) byte range, line-aligned: a raw range
+        boundary is pushed forward past the line containing it (unless it
+        already sits at a line start), identically for this shard's stop
+        and the next shard's start — so every line lands in exactly one
+        shard."""
+        import os
+
+        size = os.path.getsize(self.path)
+        if self.shard_count <= 1:
+            return 0, size
+
+        def boundary(pos: int) -> int:
+            if pos <= 0 or pos >= size:
+                return min(max(pos, 0), size)
+            with open(self.path, "rb") as fh:
+                fh.seek(pos - 1)
+                if fh.read(1) != b"\n":
+                    fh.readline()  # mid-line: the line belongs to the left
+                return fh.tell()
+
+        lo = size * self.shard_index // self.shard_count
+        hi = size * (self.shard_index + 1) // self.shard_count
+        return boundary(lo), boundary(hi)
+
+    def produce(self) -> Iterator:
+        while True:
+            lo, hi = self._shard_range()
+            with open(self.path, "rb") as fh:
+                fh.seek(lo)
+                remaining = hi - lo
+                carry = b""
+                while True:
+                    chunk = (
+                        fh.read(min(self.block_bytes, remaining))
+                        if remaining > 0
+                        else b""
+                    )
+                    remaining -= len(chunk)
+                    if not chunk:
+                        # drain the tail, looping in case a parse stops at a
+                        # capacity bound mid-buffer (carry keeps the rest)
+                        data = carry
+                        while data.strip():
+                            if not data.endswith(b"\n"):
+                                data += b"\n"
+                            block, rest = self._parse(data)
+                            if block is not None and block.rows:
+                                yield block
+                            if not rest or rest == data:
+                                break
+                            data = rest
+                        break
+                    block, carry = self._parse(carry + chunk)
+                    if block is not None and block.rows:
+                        yield block
+            if not self.loop:
+                return
+
+
+
 class SyntheticSource(Source):
     """Generate tweets whose retweet counts follow a known linear function of
     the features — gives analytically checkable RMSE curves (SURVEY.md §7
@@ -381,6 +461,45 @@ class ShardedSource(Source):
         for i, status in enumerate(self.inner.produce()):
             if i % self.count == self.index:
                 yield status
+
+
+class IdShardedSource(Source):
+    """Take rows whose status id ≡ ``index`` (mod ``count``) from an inner
+    source — the LIVE-stream intake shard of a multi-host run (BASELINE
+    config #5's "4-way sharded stream" for ``--source twitter``, r5). A
+    live sample stream has no deterministic item order across separately
+    opened connections, so the round-robin ``ShardedSource`` cannot shard
+    it; the tweet's snowflake id CAN — every host opens its own connection
+    (duplicated ingress, tens of KB/s at real stream rates) and keeps a
+    disjoint id-residue slice, so the union of all hosts' rows is the
+    stream and no tweet trains twice. Rows without an id (id 0 — not
+    produced by the real API) land on shard 0."""
+
+    name = "idshard"
+
+    def __init__(self, inner: Source, index: int, count: int, **kw):
+        # supervision runs on THIS wrapper, so the inner source's restart
+        # budget/backoff must carry through (the live receiver retries
+        # indefinitely — twitter.py)
+        kw.setdefault("max_restarts", inner.max_restarts)
+        kw.setdefault("restart_backoff", inner.restart_backoff)
+        super().__init__(**kw)
+        if not 0 <= index < count:
+            raise ValueError(f"shard index {index} out of range for {count}")
+        self.inner = inner
+        self.index = index
+        self.count = count
+
+    def produce(self) -> Iterator[Status]:
+        for status in self.inner.produce():
+            if status.id % self.count == self.index:
+                yield status
+
+    def _backoff(self, exc: Exception, restarts: int) -> float:
+        # delegate to the live source's error-class-aware ladder (420 vs
+        # HTTP vs transport) — the supervisor wraps THIS source, so the
+        # inner one's policy must carry through
+        return self.inner._backoff(exc, restarts)
 
 
 class MultiSource(Source):

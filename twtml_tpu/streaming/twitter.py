@@ -34,7 +34,7 @@ from ..features.featurizer import Status
 from ..utils import get_logger
 from .httpstream import RateLimitedError, StreamHTTPError, open_stream
 from .oauth1 import authorization_header
-from .sources import Source
+from .sources import BlockParserMixin, Source
 
 log = get_logger("streaming.twitter")
 
@@ -141,4 +141,91 @@ class TwitterSource(Source):
             # a live stream never ends on purpose: a server-side close is a
             # disconnect, and the supervisor must reconnect (Twitter4j does
             # the same). Injected test streams DO end meaningfully.
+            raise ConnectionError("stream ended by server; reconnecting")
+
+
+class BlockTwitterSource(BlockParserMixin, TwitterSource):
+    """The live stream through the NATIVE block parser (r5 — live
+    ``--ingest block``): raw JSON lines from the connection accumulate into
+    byte blocks and each block goes through ``native.parse_tweet_block``
+    (the same C scanner + filter as replay block ingest, differential-
+    tested against the Status path), yielding columnar ParsedBlocks with no
+    per-tweet Python objects between the socket and the featurizer.
+
+    Why: config #2's full-app rate sat ~2× below its protocol stage —
+    the gap is exactly the per-line ``json.loads`` + Status assembly on the
+    one usable core, which the replay path already deletes with this
+    parser (~14× — BENCHMARKS.md component rates).
+
+    Flush policy: a block parses when the buffer reaches ``block_bytes``
+    OR the first stream activity (line or keep-alive) at least
+    ``flush_seconds`` after its first buffered line. The clock is checked
+    when the blocking line iterator yields, so on a QUIET stream the real
+    latency bound is the protocol's ~30 s keep-alive cadence, not
+    ``flush_seconds`` — acceptable for this source's regimes (the real
+    sample stream runs 50–100 tweets/s and measurement streams far
+    faster; a latency-critical quiet stream should keep object ingest)."""
+
+    name = "twitter-block"
+
+    def __init__(
+        self,
+        credentials: "dict[str, str]",
+        num_retweet_begin: int = 100,
+        num_retweet_end: int = 1000,
+        block_bytes: int = 1 << 18,
+        flush_seconds: float = 0.5,
+        **kw,
+    ):
+        super().__init__(credentials, **kw)
+        self.begin = num_retweet_begin
+        self.end = num_retweet_end
+        self.block_bytes = block_bytes
+        self.flush_seconds = flush_seconds
+
+    @classmethod
+    def from_properties(cls, **kw) -> "BlockTwitterSource":
+        src = TwitterSource.from_properties()
+        kw.setdefault("url", src.url)
+        return cls(src.credentials, **kw)
+
+    def _parse_block(self, data: bytes):
+        """bytes → merged ParsedBlock | None (the shared C-parser stage
+        with its Python ground-truth fallback, sources.BlockParserMixin)."""
+        from ..features.blocks import merge_blocks
+
+        blocks = self.parse_buffer(data)
+        if not blocks:
+            return None
+        merged = merge_blocks(blocks)
+        return merged if merged.rows else None
+
+    def produce(self) -> "Iterator":
+        import time as _time
+
+        buf: list[bytes] = []
+        nbytes = 0
+        first_t = 0.0
+        for line in self._connect():
+            line = line.strip()
+            now = _time.monotonic()
+            if line:
+                if not buf:
+                    first_t = now
+                raw = line.encode("utf-8") + b"\n"
+                buf.append(raw)
+                nbytes += len(raw)
+            if buf and (
+                nbytes >= self.block_bytes
+                or now - first_t >= self.flush_seconds
+            ):
+                block = self._parse_block(b"".join(buf))
+                buf, nbytes = [], 0
+                if block is not None:
+                    yield block
+        if buf:
+            block = self._parse_block(b"".join(buf))
+            if block is not None:
+                yield block
+        if self._connect_fn is None:
             raise ConnectionError("stream ended by server; reconnecting")

@@ -27,7 +27,12 @@ log = get_logger("utils.rss")
 
 def rss_mb() -> float:
     """Current resident set size in MB (statm is a no-syscall read on
-    Linux; ru_maxrss — the high-water mark — is the portable fallback)."""
+    Linux; ru_maxrss — the high-water mark — is the portable fallback).
+
+    Linux-only assumptions in the fallback: ru_maxrss is KB on Linux but
+    BYTES on macOS (where this would over-report ~1000×), and a high-water
+    mark can never shrink the way the statm reading can. Harmless on this
+    rig; gate on sys.platform before reusing elsewhere."""
     try:
         with open("/proc/self/statm") as fh:
             pages = int(fh.read().split()[1])
