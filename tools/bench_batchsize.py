@@ -14,6 +14,11 @@ every arm equally) and the report gives paired per-round ratios vs the
 b2048 incumbent — the same methodology as tools/bench_2e18.py.
 
 Usage: python tools/bench_batchsize.py [--tweets N] [--budget S]
+       [--config headline|logistic] [--batches 2048,8192,...]
+``--config logistic`` sweeps CONFIG #3's own pipeline (lexicon sentiment
+labeler + logistic learner, ragged+packed) instead of the headline's —
+VERDICT r4 #6: the suite default there was set by analogy to the headline
+profile; this measures it on the config itself.
 Prints one JSON line.
 """
 
@@ -31,7 +36,7 @@ sys.path.insert(0, REPO)
 
 def main(argv=None) -> None:
     args = list(sys.argv[1:] if argv is None else argv)
-    n_tweets, budget = 131072, 300.0
+    n_tweets, budget, config = 131072, 300.0, "headline"
     batches = (1024, 2048, 4096, 8192, 16384, 32768)
     i = 0
     while i < len(args):
@@ -41,6 +46,10 @@ def main(argv=None) -> None:
             budget = float(args[i + 1]); i += 2
         elif args[i] == "--batches":
             batches = tuple(int(b) for b in args[i + 1].split(",")); i += 2
+        elif args[i] == "--config":
+            config = args[i + 1]; i += 2
+            if config not in ("headline", "logistic"):
+                raise SystemExit(f"unknown --config {config!r}")
         else:
             raise SystemExit(f"unknown flag {args[i]!r}")
     if 2048 not in batches:
@@ -49,11 +58,27 @@ def main(argv=None) -> None:
     import jax
 
     from twtml_tpu.features.featurizer import Featurizer
-    from twtml_tpu.models import StreamingLinearRegressionWithSGD
+    from twtml_tpu.models import (
+        StreamingLinearRegressionWithSGD,
+        StreamingLogisticRegressionWithSGD,
+    )
     from twtml_tpu.streaming.sources import SyntheticSource
     from twtml_tpu.utils.benchloop import _run_once
 
     feat = Featurizer(now_ms=1785320000000)
+    if config == "logistic":
+        # config #3's exact pipeline: lexicon sentiment labels via the C
+        # batched labeler + the logistic learner (tools/bench_suite.py)
+        from twtml_tpu.features.sentiment import (
+            sentiment_label,
+            sentiment_labels,
+        )
+
+        feat.label_fn = sentiment_label
+        feat.batch_label_fn = sentiment_labels
+        model_cls = StreamingLogisticRegressionWithSGD
+    else:
+        model_cls = StreamingLinearRegressionWithSGD
     statuses = list(SyntheticSource(total=n_tweets, seed=3).produce())
 
     arms: dict = {}
@@ -68,7 +93,7 @@ def main(argv=None) -> None:
                 c, row_bucket=batch, pre_filtered=True, pack=True
             )
 
-        m = StreamingLinearRegressionWithSGD()
+        m = model_cls()
         for _ in range(2):
             float(m.step(fz(chunks[0])).mse)  # completion-fetch warmup
 
@@ -88,7 +113,7 @@ def main(argv=None) -> None:
             dt, _ = run()
             times[name].append(dt)
 
-    out = {"config": "headline_batch_sweep", "tweets": n_tweets,
+    out = {"config": f"{config}_batch_sweep", "tweets": n_tweets,
            "backend": jax.default_backend(), "rounds": len(times["b2048"])}
     base = times["b2048"]
     for name, ts in times.items():

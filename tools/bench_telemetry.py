@@ -186,9 +186,28 @@ def main(argv=None) -> None:
                     consume(host, None, 0.0)
         return time.perf_counter() - t0
 
+    from twtml_tpu.apps.common import SuperBatcher
+
+    def super_ragged_pass():
+        """r5: --superBatch 8 on the RAGGED wire through the shipped
+        SuperBatcher (stacked [K, N] buffers scan with row_len static;
+        grouping by shape signature) — the composition VERDICT r4 #1c
+        asked to wire and measure. Same per-batch handler work."""
+        model.reset()
+        t0 = time.perf_counter()
+        sb = SuperBatcher(model, 8, consume, fetch_depth=4)
+        for rb in r_batches:
+            sb.on_batch(rb, 0.0)
+        sb.flush()
+        return time.perf_counter() - t0
+
+    if groups:
+        super_ragged_pass()  # warm the ragged scan programs (per layout)
+
     times = {"sync": [], "lag": [], "pool8": [], "fetchpipe": []}
     if groups:
         times["super8_pool4"] = []
+        times["super8_ragged"] = []
     t_end = time.perf_counter() + budget
     while time.perf_counter() < t_end:
         times["sync"].append(sync_pass())
@@ -197,6 +216,7 @@ def main(argv=None) -> None:
         times["fetchpipe"].append(fetchpipe_pass())
         if groups:
             times["super8_pool4"].append(super_pool_pass())
+            times["super8_ragged"].append(super_ragged_pass())
 
     out = {"regime": "per-batch-telemetry", "batch": batch,
            "tweets": n_tweets, "backend": jax.default_backend(),
@@ -207,11 +227,26 @@ def main(argv=None) -> None:
             "tweets_per_sec_median": round(n_tweets / statistics.median(ts), 1),
         }
     for name in [
-        k for k in ("lag", "pool8", "fetchpipe", "super8_pool4") if k in times
+        k
+        for k in (
+            "lag", "pool8", "fetchpipe", "super8_pool4", "super8_ragged",
+        )
+        if k in times
     ]:
         out[name]["paired_speedup_vs_sync"] = round(
             statistics.median(
                 [s / t for s, t in zip(times["sync"], times[name])]
+            ),
+            3,
+        )
+    if "super8_ragged" in times:
+        # the composition question directly: does the superbatch stack on
+        # the shipped ragged fetch pipeline?
+        out["super8_ragged"]["paired_vs_fetchpipe"] = round(
+            statistics.median(
+                [f / t for f, t in zip(
+                    times["fetchpipe"], times["super8_ragged"]
+                )]
             ),
             3,
         )
