@@ -46,3 +46,23 @@ def test_twitter_live_measures_local_protocol_without_creds(clean_properties):
     assert rec["tweets_per_sec"] > 0
     assert rec["protocol_tweets_per_sec"] > 0
     assert rec["batches"] >= 1
+
+
+def test_bench_meshpack_smoke(capsys):
+    """The mesh-pack paired bench (tools/bench_meshpack.py, r5) must run on
+    the virtual CPU mesh: both arms execute through ParallelSGDModel and
+    the tool itself asserts per-round final-mse bit-identity between the
+    packed and unpacked wire — a CI-side guard for the pack_for_wire
+    path on a multi-device data axis."""
+    import json
+
+    import bench_meshpack
+
+    bench_meshpack.main(
+        ["--devices", "2", "--tweets", "2048", "--batch", "1024",
+         "--budget", "0.5"]
+    )
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["devices"] == 2 and rec["rounds"] >= 1
+    assert rec["final_mse_bit_identical"] is True
+    assert rec["packed"]["paired_speedup_vs_unpacked"] > 0
