@@ -582,6 +582,11 @@ class SuperBatcher:
         self._sig = None
         self._inflight: list = []  # [(future, group)] oldest first
         self._dispatched = 0
+        # checkpoint cadence runs on its own MONOTONIC counter, exactly as
+        # in FetchPipeline: a refund_dispatch adjusts only the cap
+        # accounting and must not drift the boundary cadence (r5 review —
+        # the same r3 advisor finding, re-introduced here)
+        self._cadence = 0
 
     @staticmethod
     def _signature(batch):
@@ -657,6 +662,7 @@ class SuperBatcher:
                 fetch = self._fetch_one or jax.device_get
                 out = fetch(self.model.step(batch))
                 self._dispatched += 1
+                self._cadence += 1
                 self.handle(out, batch, t, at_boundary=True)
             return
         # backpressure + timeliness, as in FetchPipeline (the already-done
@@ -673,11 +679,12 @@ class SuperBatcher:
              group)
         )
         self._dispatched += len(group)
+        self._cadence += len(group)
         if self.boundary_every and (
-            self._dispatched - self._last_boundary >= self.boundary_every
+            self._cadence - self._last_boundary >= self.boundary_every
         ):
             self._drain()  # cadence point: weights current for checkpoints
-            self._last_boundary = self._dispatched
+            self._last_boundary = self._cadence
 
     def flush(self) -> None:
         self._close_group()  # a partial tail drains inflight itself

@@ -110,6 +110,15 @@ def _ragged_local_aligned(batch: RaggedUnitBatch, mesh) -> RaggedUnitBatch:
         )
     num_data = mesh.shape[mesh.axis_names[0]]
     local_shards = num_data // jax.process_count()
+    if batch.num_shards == local_shards > 1:
+        # already local-aligned: on the multi-host path the only producer
+        # of this layout is a prior call of this function, whose per-shard
+        # capacity IS the agreed bucket — skip the re-allgather (the
+        # superbatch partial-group step would otherwise pay one redundant
+        # DCN round trip per batch, r5 review). local_shards == 1 cannot
+        # distinguish a fresh flat batch from a prepared one, so that
+        # topology keeps the collective.
+        return batch
     need = ragged_shard_bucket(batch, local_shards)
     agreed = int(
         multihost_utils.process_allgather(

@@ -356,18 +356,11 @@ class BlockReplayFileSource(BlockParserMixin, Source):
                     )
                     remaining -= len(chunk)
                     if not chunk:
-                        # drain the tail, looping in case a parse stops at a
-                        # capacity bound mid-buffer (carry keeps the rest)
-                        data = carry
-                        while data.strip():
-                            if not data.endswith(b"\n"):
-                                data += b"\n"
-                            block, rest = self._parse(data)
-                            if block is not None and block.rows:
-                                yield block
-                            if not rest or rest == data:
-                                break
-                            data = rest
+                        # drain the tail through the shared capacity-bound
+                        # loop (parse_buffer — one copy of the stall guard
+                        # for both block sources, r5 review)
+                        for block in self.parse_buffer(carry):
+                            yield block
                         break
                     block, carry = self._parse(carry + chunk)
                     if block is not None and block.rows:
