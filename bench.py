@@ -174,6 +174,12 @@ def main() -> None:
             "median": round(
                 device_result.get("median_tweets_per_sec", value), 1
             ),
+            # tunnel health-phase counts over the pass loop (the rolling
+            # completion-fetch classifier, telemetry/metrics.py): how many
+            # passes sat in a healthy vs degraded window, and how often the
+            # phase flipped — the per-run form of the r2 "health phases"
+            # story, so a degraded-budget run explains its own median
+            "health": device_result.get("health"),
         }
     elif cpu_result:
         record = {

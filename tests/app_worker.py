@@ -28,8 +28,13 @@ pid, nprocs, port, ndev = (
 app_name, app_args = sys.argv[5], list(sys.argv[6:])
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_cpu_collectives_implementation", "gloo")
-jax.config.update("jax_num_cpu_devices", ndev)
+if nprocs > 1:
+    # gloo needs the distributed client on older jax (0.4.x requires it at
+    # backend init): only request it when this worker actually joins a group
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+from twtml_tpu.utils.backend import set_cpu_device_count_hint  # noqa: E402
+
+set_cpu_device_count_hint(ndev)  # jax_num_cpu_devices or XLA_FLAGS fallback
 
 if nprocs > 1:
     app_args += [

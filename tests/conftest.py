@@ -13,7 +13,18 @@ import sys
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax without the config option (pre-backend-init here, so the
+    # classic env-var route still applies — utils/backend.py keeps the same
+    # fallback for the driver entry points)
+    _flag = "--xla_force_host_platform_device_count=8"
+    _parts = [
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    os.environ["XLA_FLAGS"] = " ".join(_parts + [_flag])
 
 # Make the repo importable without installation (no-network image: pip install
 # of the package is not possible, tests import straight from the source tree).

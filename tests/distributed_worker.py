@@ -28,7 +28,9 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_cpu_collectives_implementation", "gloo")
-jax.config.update("jax_num_cpu_devices", 2)
+from twtml_tpu.utils.backend import set_cpu_device_count_hint  # noqa: E402
+
+set_cpu_device_count_hint(2)  # jax_num_cpu_devices or XLA_FLAGS fallback
 
 
 def main() -> None:

@@ -119,6 +119,40 @@ def test_config_frame_resets_counters_and_rebuilds_iframes():
     ]
 
 
+def test_metrics_frame_updates_observability_panel():
+    """Metrics frames (telemetry/metrics.py snapshots) drive the pipeline
+    panel: tunnel badge with phase class, rtt, wire MB, rss, fetch depth."""
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="Metrics",
+        counters={"wire.bytes": 2500000},
+        gauges={"host.rss_mb": 512.5, "fetch.queue_depth": 7},
+        health={"phase": "degraded", "rtt_ms": 412.5, "transitions": 3},
+    ))
+    assert h.el("tunnelPhase").text == "degraded"
+    assert "degraded" in h.el("tunnelPhase").class_set
+    assert h.el("rttMs").text == "412.5"
+    assert h.el("wireMb").text == "2.5"
+    assert h.el("rssMb").text == "512.5"
+    assert h.el("fetchDepth").text == "7"
+    assert h.el("phaseFlips").text == "3"
+    # recovery flips the badge class back
+    h.ws.server_message(frame(
+        jsonClass="Metrics", counters={}, gauges={},
+        health={"phase": "healthy", "rtt_ms": 71.0, "transitions": 4},
+    ))
+    assert h.el("tunnelPhase").text == "healthy"
+    assert "healthy" in h.el("tunnelPhase").class_set
+    assert "degraded" not in h.el("tunnelPhase").class_set
+
+
+def test_metrics_backfill_fetched_on_boot():
+    h = dashboard()
+    urls = [u for u, _ in h.fetches]
+    assert "/api/metrics" in urls
+
+
 def test_unknown_jsonclass_is_ignored():
     h = dashboard()
     h.ws.server_open()

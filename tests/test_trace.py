@@ -1,0 +1,224 @@
+"""Pipeline tracing (telemetry/trace.py + tools/trace_report.py): span
+nesting, crash flush, the off-by-default null tracer, trace_report's
+malformed-file check — and the tier-1 integration smoke: a ``--trace`` run
+of the linear-regression entry on the local replay source produces a
+Perfetto-valid trace with every expected stage name and ZERO extra host
+fetches vs the untraced run (the BENCHMARKS.md measurement-integrity
+constraint, asserted against FetchPipeline's one-fetch-per-batch)."""
+
+import json
+
+import pytest
+
+from tools import trace_report
+from twtml_tpu.telemetry import trace
+from twtml_tpu.telemetry import metrics as metrics_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    yield
+    trace.uninstall()
+
+
+def test_null_tracer_is_noop():
+    tr = trace.get()
+    assert not tr.enabled
+    with tr.span("anything", rows=1):
+        pass
+    tr.instant("x")
+    tr.counter("y", v=1)
+    tr.close()  # all no-ops
+
+
+def test_span_nesting_and_args(tmp_path):
+    path = str(tmp_path / "t.trace")
+    tr = trace.install(path)
+    with tr.span("featurize", items=3) as sp:
+        with tr.span("parse"):
+            pass
+        sp.add(rows=4, wire_bytes=128)
+    trace.uninstall()
+    events = trace_report.load_events(path)
+    spans = {e["name"]: e for e in events if e.get("ph") == "X"}
+    assert set(spans) == {"featurize", "parse"}
+    assert spans["featurize"]["args"] == {
+        "items": 3, "rows": 4, "wire_bytes": 128,
+    }
+    # nesting: the inner span lies within the outer span's window
+    outer, inner = spans["featurize"], spans["parse"]
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1
+
+
+def test_crash_flush_leaves_events_on_disk(tmp_path):
+    """Line-buffered writes: a crash mid-run (no close()) must still leave
+    every completed span on disk, and the span an exception escaped through
+    is recorded with the error class."""
+    path = str(tmp_path / "crash.trace")
+    tr = trace.install(path)
+    with pytest.raises(RuntimeError):
+        with tr.span("dispatch", depth=2):
+            raise RuntimeError("boom")
+    # read WITHOUT closing — simulating a crashed process's file
+    events = trace_report.load_events(path)
+    (ev,) = [e for e in events if e.get("ph") == "X"]
+    assert ev["name"] == "dispatch"
+    assert ev["args"]["error"] == "RuntimeError"
+
+
+def test_instant_and_counter_events(tmp_path):
+    path = str(tmp_path / "i.trace")
+    tr = trace.install(path)
+    tr.instant("health_phase", phase="degraded", latency_ms=412.0)
+    tr.counter("fetch.queue_depth", depth=5)
+    trace.uninstall()
+    events = trace_report.load_events(path)
+    kinds = {e["ph"] for e in events}
+    assert "i" in kinds and "C" in kinds
+    summary = trace_report.summarize(events)
+    assert summary["health_transitions"] == [
+        {"phase": "degraded", "latency_ms": 412.0}
+    ]
+
+
+# ---------------------------------------------------------------------------
+# trace_report as a CHECK (bench scripts gate on its exit status)
+
+
+def test_trace_report_exit_codes(tmp_path):
+    good = tmp_path / "good.trace"
+    tr = trace.install(str(good))
+    with tr.span("featurize"):
+        pass
+    trace.uninstall()
+    assert trace_report.main([str(good)]) == 0
+    assert trace_report.main([str(good), "--json"]) == 0
+
+    bad = tmp_path / "bad.trace"
+    bad.write_text("this is { not a trace\n")
+    assert trace_report.main([str(bad)]) == 2
+    empty = tmp_path / "empty.trace"
+    empty.write_text("")
+    assert trace_report.main([str(empty)]) == 2
+    only_bracket = tmp_path / "brackets.trace"
+    only_bracket.write_text("[\n")
+    assert trace_report.main([str(only_bracket)]) == 2
+    missing = tmp_path / "missing.trace"
+    assert trace_report.main([str(missing)]) == 2
+    # a JSON document that parses but is not a trace
+    scalar = tmp_path / "scalar.trace"
+    scalar.write_text("42")
+    assert trace_report.main([str(scalar)]) == 2
+
+
+def test_trace_report_accepts_closed_json_array(tmp_path):
+    path = tmp_path / "closed.trace"
+    path.write_text(json.dumps([
+        {"name": "parse", "ph": "X", "ts": 0, "dur": 1000, "pid": 1,
+         "tid": 1, "args": {"bytes": 10}},
+    ]))
+    summary = trace_report.summarize(trace_report.load_events(str(path)))
+    assert summary["stages"]["parse"]["count"] == 1
+    assert summary["stages"]["parse"]["bytes"] == 10
+
+
+# ---------------------------------------------------------------------------
+# integration smoke (tier-1, fast): the flagship app under --trace
+
+
+def _write_replay(tmp_path, n):
+    from tools.bench_suite import _status_json
+    from twtml_tpu.streaming.sources import SyntheticSource
+
+    path = tmp_path / "tweets.jsonl"
+    with open(path, "w") as fh:
+        for s in SyntheticSource(
+            total=n, seed=7, base_ms=1785320000000
+        ).produce():
+            fh.write(json.dumps(_status_json(s)) + "\n")
+    return path
+
+
+def _run_linear(tmp_path, extra):
+    """Run the flagship app over a 4-batch corpus (to natural exhaustion, so
+    the source thread flushes its aggregated parse span), counting every
+    jax.device_get — the ONLY host fetch the back-to-back pipeline makes
+    (FetchPipeline submits one per batch)."""
+    import jax
+
+    from twtml_tpu.apps import linear_regression as app
+    from twtml_tpu.config import ConfArguments
+
+    jax.devices()  # lock the conftest's backend before local[1]
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    path = _write_replay(tmp_path, 4 * 16)
+    conf = ConfArguments().parse([
+        "--source", "replay", "--replayFile", str(path),
+        "--seconds", "0", "--backend", "cpu",
+        "--batchBucket", "16", "--tokenBucket", "64",
+        "--master", "local[1]",
+    ] + extra)
+    calls = {"n": 0}
+    real = jax.device_get
+
+    def counting(x):
+        calls["n"] += 1
+        return real(x)
+
+    jax.device_get = counting
+    try:
+        totals = app.run(conf)
+    finally:
+        jax.device_get = real
+    return totals, calls["n"]
+
+
+def test_trace_smoke_linear_app(tmp_path):
+    """Acceptance: a --trace replay run yields a valid trace containing
+    every pipeline stage, with per-batch dispatch/fetch spans, and the
+    tracing adds no host fetches (fetch count == batches, and == the
+    untraced run's count)."""
+    metrics_mod.reset_for_tests()
+    totals_off, fetches_off = _run_linear(tmp_path / "off", [])
+    assert totals_off["batches"] == 4
+    assert fetches_off == 4  # FetchPipeline: exactly one fetch per batch
+
+    metrics_mod.reset_for_tests()
+    trace_path = tmp_path / "run.trace"
+    totals_on, fetches_on = _run_linear(
+        tmp_path / "on", ["--trace", str(trace_path)]
+    )
+    assert totals_on["batches"] == totals_off["batches"]
+    # ZERO extra host fetches from instrumentation (measurement integrity)
+    assert fetches_on == fetches_off
+
+    # the registry saw the same story
+    reg = metrics_mod.get_registry().snapshot()
+    assert reg["counters"]["fetch.count"] == 4
+    assert reg["counters"]["pipeline.batches"] == 4
+    assert reg["counters"]["pipeline.tweets"] == totals_on["count"]
+    assert reg["counters"]["wire.bytes"] > 0
+    assert reg["histograms"]["fetch.latency_s"]["count"] == 4
+
+    # trace is valid (trace_report exit 0) and carries the stage set
+    assert trace_report.main([str(trace_path)]) == 0
+    summary = trace_report.summarize(
+        trace_report.load_events(str(trace_path))
+    )
+    stages = set(summary["stages"])
+    for stage in ("source_read", "parse", "featurize", "dispatch", "fetch",
+                  "stats_publish"):
+        assert stage in stages, f"missing stage {stage} in {stages}"
+    # per-batch stages traced once per batch
+    assert summary["stages"]["dispatch"]["count"] == 4
+    assert summary["stages"]["fetch"]["count"] == 4
+    # featurize spans carry bytes-on-wire (the bottleneck-ladder input)
+    assert summary["stages"]["featurize"]["bytes"] > 0
+
+
+def test_trace_off_leaves_no_file(tmp_path):
+    metrics_mod.reset_for_tests()
+    _run_linear(tmp_path, [])
+    assert not list(tmp_path.glob("*.trace"))
+    assert not trace.get().enabled

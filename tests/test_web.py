@@ -141,6 +141,30 @@ def test_series_roundtrip_and_window(server):
         assert len(json.loads(resp.read())) == SERIES_WINDOW
 
 
+def test_metrics_roundtrip_and_default(server):
+    """Additive Metrics messages: cached last-value (in-memory, like Stats),
+    served at /api/metrics for the dashboard's observability panel."""
+    _, url, _ = server
+    import urllib.request
+
+    with urllib.request.urlopen(url + "/api/metrics", timeout=2) as resp:
+        empty = json.loads(resp.read())
+    assert empty["jsonClass"] == "Metrics"
+    assert empty["counters"] == {} and empty["health"] == {}
+
+    client = WebClient(url)
+    client.metrics(
+        {"pipeline.batches": 12, "wire.bytes": 1234567},
+        {"fetch.queue_depth": 3, "host.rss_mb": 512.5},
+        {"phase": "degraded", "rtt_ms": 412.0, "transitions": 2},
+    )
+    with urllib.request.urlopen(url + "/api/metrics", timeout=2) as resp:
+        got = json.loads(resp.read())
+    assert got["counters"]["pipeline.batches"] == 12
+    assert got["gauges"]["host.rss_mb"] == 512.5
+    assert got["health"]["phase"] == "degraded"
+
+
 def test_http_post_broadcasts_to_websockets(server):
     _, url, _ = server
     ws_url = url.replace("http://", "ws://") + "/api"

@@ -74,7 +74,11 @@ def _chained_step_time(dispatch, fetch, k1: int = 8, k2: int = 72,
         run_pass, repeats=min_reps, time_budget_s=budget_s,
         settled_after=settle,
     )
-    med = sorted(times)[len(times) // 2]
+    # statistics.median, not sorted(times)[len//2]: the upper-middle pick
+    # is biased high on even-length samples (ADVICE r5)
+    import statistics
+
+    med = statistics.median(times)
     return best, len(times), round(med / best, 3)
 
 

@@ -147,10 +147,12 @@ def main(argv=None) -> None:
     _write_corpus(replay, total)
     gen_s = time.monotonic() - t0
     # APPEND the repo to PYTHONPATH — platform plugins (the axon tunnel's
-    # jax backend) register via entries already on it
+    # jax backend) register via entries already on it, and operator modules
+    # on the existing path keep precedence over same-named repo files
     env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + (
-        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    env["PYTHONPATH"] = (
+        env["PYTHONPATH"] + os.pathsep + REPO
+        if env.get("PYTHONPATH") else REPO
     )
 
     # ---- phase 1: calibrate the natural retention on this transport ----
@@ -169,7 +171,9 @@ def main(argv=None) -> None:
          if run_a.first_stat_t and t >= run_a.first_stat_t),
         run_a.samples[-1][1] if run_a.samples else 0.0,
     )
-    peak_a = max(mb for (_, mb) in run_a.samples)
+    # default=0.0: a sub-250ms crash leaves no samples, and the empty-max
+    # ValueError would mask the {"ok": false} line below (ADVICE r5)
+    peak_a = max((mb for (_, mb) in run_a.samples), default=0.0)
     growth = peak_a - base
     if growth < 50.0:
         print(json.dumps({
@@ -226,7 +230,9 @@ def main(argv=None) -> None:
     # (the recycler acts at the NEXT boundary, so one cadence of overshoot
     # is by design; life 2 replays the whole file under MAX=1)
     bound_mb = ceiling + growth + 256
-    bounded = max(mb for (_, mb) in run_b.samples) <= bound_mb
+    bounded = max(
+        (mb for (_, mb) in run_b.samples), default=0.0
+    ) <= bound_mb
 
     import shutil
 

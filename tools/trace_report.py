@@ -1,0 +1,141 @@
+"""Summarize a ``--trace`` pipeline trace into the per-stage time budget.
+
+Reads the Chrome-trace-event file ``telemetry/trace.py`` writes (a ``[``
+line + one event per line, trailing commas — also valid input for Perfetto)
+and prints the per-stage table that used to take a bench investigation to
+reconstruct: total/mean/max milliseconds and event count per stage, wire
+bytes, health-phase transitions.
+
+Exit status is a CHECK (bench scripts gate on it): 0 = a valid trace with at
+least one pipeline span; 2 = malformed (unparseable event line, no events,
+or not a trace at all). ``--json`` emits one machine-readable JSON line
+instead of the table.
+
+Usage: python tools/trace_report.py TRACE_FILE [--json]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+class MalformedTrace(ValueError):
+    pass
+
+
+def load_events(path: str) -> list[dict]:
+    """Parse the trace file into event dicts. Tolerates the incremental
+    array decoration (leading ``[``/trailing ``]``, per-line trailing
+    commas) and a plain JSON-array file; raises MalformedTrace on anything
+    that is not a sequence of event objects."""
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    stripped = text.strip()
+    if not stripped:
+        raise MalformedTrace("empty trace file")
+    events: list[dict] = []
+    try:
+        # complete-JSON path (a hand-closed array, or {"traceEvents": [...]})
+        doc = json.loads(stripped)
+        if isinstance(doc, dict):
+            doc = doc.get("traceEvents")
+        if not isinstance(doc, list):
+            raise MalformedTrace("JSON document is not a trace event array")
+        events = doc
+    except json.JSONDecodeError:
+        # incremental form: one event per line, trailing commas
+        for lineno, raw in enumerate(text.splitlines(), 1):
+            line = raw.strip().rstrip(",")
+            if line in ("", "[", "]"):
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError as exc:
+                raise MalformedTrace(f"line {lineno}: {exc}") from exc
+    if not events:
+        raise MalformedTrace("no events in trace")
+    for ev in events:
+        if not isinstance(ev, dict) or "ph" not in ev:
+            raise MalformedTrace(f"not a trace event: {ev!r}")
+    return events
+
+
+def summarize(events: list[dict]) -> dict:
+    """Aggregate complete ("X") spans per stage + health-phase marks."""
+    stages: dict[str, dict] = {}
+    phases: list[dict] = []
+    for ev in events:
+        if ev.get("ph") == "X":
+            name = ev.get("name", "?")
+            dur_ms = float(ev.get("dur", 0.0)) / 1e3
+            st = stages.setdefault(
+                name,
+                {"count": 0, "total_ms": 0.0, "max_ms": 0.0, "bytes": 0},
+            )
+            st["count"] += 1
+            st["total_ms"] += dur_ms
+            st["max_ms"] = max(st["max_ms"], dur_ms)
+            args = ev.get("args") or {}
+            for key in ("wire_bytes", "bytes"):
+                if key in args:
+                    st["bytes"] += int(args[key])
+                    break
+        elif ev.get("ph") == "i" and ev.get("name") == "health_phase":
+            phases.append((ev.get("args") or {}))
+    for st in stages.values():
+        st["mean_ms"] = round(st["total_ms"] / st["count"], 3)
+        st["total_ms"] = round(st["total_ms"], 3)
+        st["max_ms"] = round(st["max_ms"], 3)
+    return {
+        "stages": dict(
+            sorted(stages.items(), key=lambda kv: -kv[1]["total_ms"])
+        ),
+        "health_transitions": phases,
+        "events": len(events),
+    }
+
+
+def render(summary: dict) -> str:
+    rows = [
+        (name, st["count"], st["total_ms"], st["mean_ms"], st["max_ms"],
+         st["bytes"])
+        for name, st in summary["stages"].items()
+    ]
+    widths = (14, 8, 12, 10, 10, 14)
+    head = ("stage", "events", "total ms", "mean ms", "max ms", "bytes")
+    out = [
+        "  ".join(h.ljust(w) for h, w in zip(head, widths)),
+        "  ".join("-" * w for w in widths),
+    ]
+    for r in rows:
+        out.append("  ".join(str(v).ljust(w) for v, w in zip(r, widths)))
+    tr = summary["health_transitions"]
+    out.append(
+        f"health-phase transitions: {len(tr)}"
+        + (f" (last → {tr[-1].get('phase')})" if tr else "")
+    )
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in args
+    args = [a for a in args if a != "--json"]
+    if len(args) != 1:
+        print(__doc__, file=sys.stderr)
+        return 2
+    try:
+        summary = summarize(load_events(args[0]))
+    except (OSError, MalformedTrace) as exc:
+        print(f"trace_report: malformed trace: {exc}", file=sys.stderr)
+        return 2
+    if as_json:
+        print(json.dumps(summary))
+    else:
+        print(render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

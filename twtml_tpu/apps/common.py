@@ -14,6 +14,8 @@ from __future__ import annotations
 
 from ..models.linear import StreamingLinearRegressionWithSGD
 from ..streaming.sources import ReplayFileSource, Source, SyntheticSource
+from ..telemetry import metrics as _metrics
+from ..telemetry import trace as _trace
 from ..utils import get_logger
 
 log = get_logger("apps.common")
@@ -83,6 +85,22 @@ def select_backend(conf) -> None:
         kinds = {d.platform for d in jax.devices()}
         if "cpu" in kinds and len(kinds) == 1:
             raise RuntimeError("--backend tpu requested but only CPU devices present")
+
+
+def install_trace(conf) -> None:
+    """``--trace PATH`` wiring shared by every entry point: activate the
+    pipeline tracer (telemetry/trace.py). Multi-host runs suffix the path
+    with the process index — every host traces its own pipeline; a shared
+    path would clobber. Call after ``select_backend`` (reading the process
+    count may initialize the backend)."""
+    path = getattr(conf, "trace", "")
+    if not path:
+        return
+    import jax
+
+    if jax.process_count() > 1:
+        path = f"{path}.p{jax.process_index()}"
+    _trace.install(path)
 
 
 def build_source(
@@ -574,6 +592,15 @@ class SuperBatcher:
         # jax.device_get
         self._fetch_many = getattr(model, "fetch_output_many", None)
         self._fetch_one = getattr(model, "fetch_output", None)
+        # observability: timed group fetches feed the tunnel-health monitor
+        # (one fetch REQUEST per group — fetch.count counts requests, so a
+        # K-group still increments by 1)
+        self._registry = _metrics.get_registry()
+        self._health = _metrics.get_health_monitor()
+        self._fetch_count = self._registry.counter("fetch.count")
+        self._fetch_hist = self._registry.histogram("fetch.latency_s")
+        self._depth_gauge = self._registry.gauge("fetch.queue_depth")
+        self._refund_count = self._registry.counter("fetch.refunds")
         self._pool = ThreadPoolExecutor(
             max_workers=self.fetch_depth,
             thread_name_prefix="twtml-group-fetch",
@@ -633,10 +660,30 @@ class SuperBatcher:
                 at_boundary=(k == last and boundary_ok),
             )
 
+    def _timed_fetch_many(self, outs, group_len: int):
+        """Timed pooled group fetch — see FetchPipeline._timed_fetch."""
+        import time as _time
+
+        import jax
+
+        fetch = self._fetch_many or jax.device_get
+        t0 = _time.perf_counter()
+        host = fetch(outs)
+        dt = _time.perf_counter() - t0
+        self._fetch_count.inc()
+        self._fetch_hist.observe(dt)
+        self._health.observe(dt)
+        tr = _trace.get()
+        if tr.enabled:
+            tr.complete("fetch", t0, dt, depth=self.fetch_depth,
+                        group=group_len)
+        return host
+
     def refund_dispatch(self) -> None:
         """Give back one ``max_dispatch`` slot (multi-host globally-empty
         batches — see FetchPipeline.refund_dispatch)."""
         self._dispatched -= 1
+        self._refund_count.inc()
 
     def _drain(self) -> None:
         while self._inflight:
@@ -656,11 +703,26 @@ class SuperBatcher:
             # Earlier groups must emit first (strict batch order), and the
             # max_dispatch cap binds here exactly like on full groups.
             self._drain()
+            import time as _time
+
+            tr = _trace.get()
             for batch, t in group:
                 if self.max_dispatch and self._dispatched >= self.max_dispatch:
                     return
+                if tr.enabled:
+                    with tr.span("dispatch"):
+                        out_dev = self.model.step(batch)
+                else:
+                    out_dev = self.model.step(batch)
                 fetch = self._fetch_one or jax.device_get
-                out = fetch(self.model.step(batch))
+                t0 = _time.perf_counter()
+                out = fetch(out_dev)
+                dt = _time.perf_counter() - t0
+                self._fetch_count.inc()
+                self._fetch_hist.observe(dt)
+                self._health.observe(dt)
+                if tr.enabled:
+                    tr.complete("fetch", t0, dt, depth=1)
                 self._dispatched += 1
                 self._cadence += 1
                 self.handle(out, batch, t, at_boundary=True)
@@ -673,11 +735,20 @@ class SuperBatcher:
             and self._inflight and self._inflight[0][0].done()
         ):
             self._emit_group()
-        outs = self.model.step_many(stack_batches([b for b, _ in group]))
+        tr = _trace.get()
+        if tr.enabled:
+            with tr.span("dispatch", group=len(group),
+                         depth=len(self._inflight)):
+                outs = self.model.step_many(
+                    stack_batches([b for b, _ in group])
+                )
+        else:
+            outs = self.model.step_many(stack_batches([b for b, _ in group]))
         self._inflight.append(
-            (self._pool.submit(self._fetch_many or jax.device_get, outs),
+            (self._pool.submit(self._timed_fetch_many, outs, len(group)),
              group)
         )
+        self._depth_gauge.set(len(self._inflight))
         self._dispatched += len(group)
         self._cadence += len(group)
         if self.boundary_every and (
@@ -757,6 +828,16 @@ class FetchPipeline:
         self._pool = ThreadPoolExecutor(
             max_workers=self.depth, thread_name_prefix="twtml-stats-fetch"
         )
+        # observability (side-channel only): every pooled fetch is timed and
+        # fed to the tunnel-health monitor + fetch-latency histogram; no
+        # extra host fetch is ever issued — the timing wraps the ONE fetch
+        # this pipeline already makes per batch
+        self._registry = _metrics.get_registry()
+        self._health = _metrics.get_health_monitor()
+        self._fetch_count = self._registry.counter("fetch.count")
+        self._fetch_hist = self._registry.histogram("fetch.latency_s")
+        self._depth_gauge = self._registry.gauge("fetch.queue_depth")
+        self._refund_count = self._registry.counter("fetch.refunds")
         self._pending: list = []  # [(future, batch, t)] oldest first
         self._dispatched = 0
         # checkpoint cadence runs on its own MONOTONIC counter: a
@@ -764,6 +845,27 @@ class FetchPipeline:
         # point twice or skip it (r3 advisor finding)
         self._cadence = 0
         self._last_boundary = 0
+
+    def _timed_fetch(self, out):
+        """The pooled host fetch, timed for the tunnel-health monitor and
+        the ``fetch`` trace stage. This wraps the ONE fetch the pipeline
+        already makes per batch — instrumentation never adds a
+        ``device_get`` (BENCHMARKS.md measurement integrity)."""
+        import time as _time
+
+        import jax
+
+        fetch = self._fetch or jax.device_get
+        t0 = _time.perf_counter()
+        host = fetch(out)
+        dt = _time.perf_counter() - t0
+        self._fetch_count.inc()
+        self._fetch_hist.observe(dt)
+        self._health.observe(dt)
+        tr = _trace.get()
+        if tr.enabled:
+            tr.complete("fetch", t0, dt, depth=self.depth)
+        return host
 
     def _emit_one(self) -> None:
         future, batch, t = self._pending.pop(0)
@@ -798,16 +900,29 @@ class FetchPipeline:
             self._emit_one()
             if stop is not None and stop():
                 return  # the cap landed on an emitted batch: do not dispatch
+        tr = _trace.get()
         if self.pack:
             from ..features.batch import pack_batch
 
             packer = self._packer or pack_batch
-            out = self.model.step(packer(batch))  # MAIN-thread dispatch
+            if tr.enabled:
+                with tr.span("wire_pack"):
+                    wire = packer(batch)
+            else:
+                wire = packer(batch)
         else:
-            out = self.model.step(batch)  # dispatch on the MAIN thread
+            wire = batch
+        if tr.enabled:
+            # argument uploads ride the dispatch on this transport (no
+            # separate device_put on the single-host hot path)
+            with tr.span("dispatch", depth=len(self._pending)):
+                out = self.model.step(wire)  # dispatch on the MAIN thread
+        else:
+            out = self.model.step(wire)  # dispatch on the MAIN thread
         self._pending.append(
-            (self._pool.submit(self._fetch or jax.device_get, out), batch, t)
+            (self._pool.submit(self._timed_fetch, out), batch, t)
         )
+        self._depth_gauge.set(len(self._pending))
         self._dispatched += 1
         self._cadence += 1
         if self.boundary_every and (
@@ -822,6 +937,7 @@ class FetchPipeline:
         dispatch for collective alignment but must not count toward a
         max-batches cap, or capped runs under-train)."""
         self._dispatched -= 1
+        self._refund_count.inc()
 
     def flush(self) -> None:
         self._drain()
@@ -973,17 +1089,35 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
             # round trip). The fetch is ~2% of a 5 s interval; a lagged
             # fetch here would delay live dashboard stats a full interval
             # for nothing.
+            import time as _time
+
+            tr = _trace.get()
             if pack:
                 from ..features.batch import pack_batch
 
-                wire = (getattr(model, "pack_for_wire", None) or pack_batch)(
-                    batch
-                )
+                packer = getattr(model, "pack_for_wire", None) or pack_batch
+                if tr.enabled:
+                    with tr.span("wire_pack"):
+                        wire = packer(batch)
+                else:
+                    wire = packer(batch)
             else:
                 wire = batch
-            out = model.step(wire)
-            fetch = getattr(model, "fetch_output", None)
-            out = fetch(out) if fetch else jax.device_get(out)
+            if tr.enabled:
+                with tr.span("dispatch"):
+                    out = model.step(wire)
+            else:
+                out = model.step(wire)
+            fetch = getattr(model, "fetch_output", None) or jax.device_get
+            t0 = _time.perf_counter()
+            out = fetch(out)
+            dt = _time.perf_counter() - t0
+            reg = _metrics.get_registry()
+            reg.counter("fetch.count").inc()
+            reg.histogram("fetch.latency_s").observe(dt)
+            _metrics.get_health_monitor().observe(dt)
+            if tr.enabled:
+                tr.complete("fetch", t0, dt, depth=1)
             handle(out, batch, t, at_boundary=True)
 
         stream.foreach_batch(skip_empty(per_batch))

@@ -39,6 +39,7 @@ from .common import (
     build_mesh,
     build_source,
     init_distributed,
+    install_trace,
     select_backend,
 )
 
@@ -96,6 +97,7 @@ def featurize(status: Status) -> np.ndarray:
 def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> dict:
     lead = init_distributed(conf)  # every entry point forms the group
     select_backend(conf)
+    install_trace(conf)
     multihost = jax.process_count() > 1
     if multihost and conf.batchBucket <= 0:
         raise SystemExit(
@@ -315,6 +317,9 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
     finally:
         # like the sibling apps: the shutdown save must survive a handler
         # exception or Ctrl-C (run_to_completion raises on the main thread)
+        from ..telemetry import trace as pipeline_trace
+
+        pipeline_trace.uninstall()  # flush + close the --trace file
         ckpt.final_save(totals)
     if ssc.failed:
         raise RuntimeError(

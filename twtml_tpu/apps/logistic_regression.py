@@ -35,6 +35,7 @@ from .common import (
     build_model,
     build_source,
     init_distributed,
+    install_trace,
     select_backend,
     warmup_compile,
 )
@@ -57,6 +58,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     import jax
 
     lockstep = jax.process_count() > 1
+    install_trace(conf)
 
     ssc = StreamingContext(batch_interval=conf.seconds)
     stream = ssc.source_stream(
@@ -127,6 +129,11 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     finally:
         ssc.stop()
         flush_group()  # drain a partial superbatch group
+        if session is not None:
+            session.publish_metrics()  # final dashboard-panel snapshot
+        from ..telemetry import trace as pipeline_trace
+
+        pipeline_trace.uninstall()  # flush + close the --trace file
         ckpt.final_save(totals)
     if ssc.failed:
         raise RuntimeError(

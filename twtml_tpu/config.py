@@ -137,6 +137,7 @@ class ConfArguments:
         self.checkpointDir: str = conf.get("checkpointDir", "")
         self.checkpointEvery: int = int(conf.get("checkpointEvery", "0"))
         self.profileDir: str = conf.get("profileDir", "")
+        self.trace: str = conf.get("trace", "")
         self.faultEvery: int = int(conf.get("faultEvery", "0"))
         self.superBatch: int = int(conf.get("superBatch", "1"))
         self.recycleAfterMb: int = int(conf.get("recycleAfterMb", "0"))
@@ -234,6 +235,11 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
   --checkpointDir <path>                       Enable model checkpoint/resume
   --checkpointEvery <int batches>              Checkpoint cadence. Default: {self.checkpointEvery}
   --profileDir <path>                          Enable jax.profiler traces
+  --trace <path.trace>                         Write a Chrome-trace-event pipeline trace
+                                               (Perfetto-loadable): per-batch stage spans
+                                               (source read/parse/featurize/dispatch/fetch/
+                                               stats) with wire bytes + health-phase stamps;
+                                               summarize with tools/trace_report.py
   --faultEvery <int tweets>                    Inject a receiver crash every N tweets (chaos testing)
   --recycleAfterMb <int MB>                    Bounded process lifetime: checkpoint at the next
                                                batch boundary and re-exec in place once process
@@ -330,6 +336,8 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
             self.checkpointEvery = int(take())
         elif flag == "--profileDir":
             self.profileDir = take()
+        elif flag == "--trace":
+            self.trace = take()
         elif flag == "--superBatch":
             self.superBatch = int(take())
         elif flag == "--recycleAfterMb":

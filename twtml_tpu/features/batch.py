@@ -232,6 +232,26 @@ def _register_ragged():
 _register_ragged()
 
 
+_WIRE_FIELDS = (
+    "token_idx", "token_val", "units", "offsets", "length",
+    "numeric", "label", "mask", "buffer",
+)
+
+
+def wire_nbytes(batch) -> int:
+    """Bytes this batch puts on the host→device wire (the sum of its array
+    fields' nbytes, whatever the batch type) — the per-batch cost the
+    upload-bound transport actually pays, recorded by the telemetry layer
+    (telemetry/trace.py spans, ``wire.bytes`` counter)."""
+    total = 0
+    for name in _WIRE_FIELDS:
+        arr = getattr(batch, name, None)
+        nbytes = getattr(arr, "nbytes", None)
+        if nbytes is not None:
+            total += int(nbytes)
+    return total
+
+
 def _shard_segment_need(rb: "RaggedUnitBatch", num_shards: int) -> int:
     """Raw units each shard segment must hold (the longest shard's real
     units) — the ONE shard-boundary computation align/bucket share."""

@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils.backend import axis_size as _axis_size
+
 from ..features.batch import (
     NUM_NUMBER_FEATURES,
     FeatureBatch,
@@ -140,7 +142,9 @@ def sgd_inner_loop(
 
     converged0 = jnp.array(False)
     if vary_axis:
-        to_varying = lambda x: lax.pcast(x, vary_axis, to="varying")
+        from ..utils.backend import pcast_varying
+
+        to_varying = lambda x: pcast_varying(x, vary_axis)
         weights = jax.tree_util.tree_map(to_varying, weights)
         converged0 = to_varying(converged0)
     w_final, _ = lax.fori_loop(0, num_iterations, body, (weights, converged0))
@@ -206,7 +210,7 @@ def dual_scale_and_alpha(dual, axis_name: str, rows: int):
     alpha_local = lax.dynamic_slice_in_dim(
         dual["alpha"], lax.axis_index(axis_name) * rows, rows
     )
-    c = lax.psum(dual["c"], axis_name) / lax.axis_size(axis_name)
+    c = lax.psum(dual["c"], axis_name) / _axis_size(axis_name)
     return c, alpha_local
 
 
@@ -310,7 +314,7 @@ def make_sgd_train_step(
         # low-precision weights. f64 weights never reach here (the auto gate
         # is f32-only — the bf16-plane G build would silently downgrade f64).
         if axis_name:
-            rows = u.shape[0] // lax.axis_size(axis_name)
+            rows = u.shape[0] // _axis_size(axis_name)
             panel = text_gram(
                 token_idx,
                 token_val,
@@ -416,7 +420,7 @@ def make_sgd_train_step(
         stats = batch_stats(labels, preds, mask, axis_name)
 
         # ---- numIterations of mini-batch SGD ----------------------------
-        b_global = batch.mask.shape[0] * (lax.axis_size(axis_name) if axis_name else 1)
+        b_global = batch.mask.shape[0] * (_axis_size(axis_name) if axis_name else 1)
         gram = (
             sparse
             and dtype == jnp.float32  # see dtype note in _gram_sgd

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+from ..utils.backend import axis_size as _axis_size
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..features.batch import (
@@ -223,7 +225,7 @@ def _make_feature_sharded_step(
 
         # ---- Gram (dual) basis when it applies (see docstring) ----------
         b_local = mask.shape[0]
-        b_global = b_local * lax.axis_size(data_axis)
+        b_global = b_local * _axis_size(data_axis)
         gram = (
             dtype == jnp.float32
             and fits_gram(b_global, f_text_local, num_iterations)
@@ -427,7 +429,9 @@ class ParallelSGDModel:
                         weights, unpack_batch(pb.buffer, pb.layout)
                     )
 
-            sharded = jax.shard_map(
+            from ..utils import shard_map
+
+            sharded = shard_map()(
                 body,
                 mesh=self.mesh,
                 in_specs=(self._w_spec, _pspecs_for(batch_cls, self.data_axis)),
@@ -450,7 +454,9 @@ class ParallelSGDModel:
             def scanned(weights, stacked_batch):
                 return lax.scan(body, weights, stacked_batch)
 
-            sharded = jax.shard_map(
+            from ..utils import shard_map
+
+            sharded = shard_map()(
                 scanned,
                 mesh=self.mesh,
                 in_specs=(

@@ -29,6 +29,8 @@ from typing import Callable
 
 from ..features.batch import FeatureBatch, UnitBatch
 from ..features.featurizer import Featurizer, Status
+from ..telemetry import metrics as _metrics
+from ..telemetry import trace as _trace
 from ..utils import get_logger
 from .sources import Source
 
@@ -168,7 +170,34 @@ class FeatureStream(RawStream):
     def _featurize(self, statuses: list) -> "FeatureBatch | UnitBatch":
         """The ONE featurize dispatch for this stream's configuration —
         shared by the per-batch path and ``featurize_empty`` so a compile
-        warmup always warms exactly the program the stream will run."""
+        warmup always warms exactly the program the stream will run.
+        Instrumented as the ``featurize`` stage (host featurize incl. wire
+        build); the span and the ``pipeline.*``/``wire.bytes`` metrics are
+        side-channel only — the batch itself is untouched."""
+        tr = _trace.get()
+        if not tr.enabled:
+            return self._featurize_impl(statuses)
+        with tr.span("featurize", items=len(statuses)) as sp:
+            batch = self._featurize_impl(statuses)
+            from ..features.batch import wire_nbytes
+
+            sp.add(
+                rows=int(batch.mask.shape[0]),
+                valid=batch.num_valid,
+                wire_bytes=wire_nbytes(batch),
+            )
+        return batch
+
+    @staticmethod
+    def _record_metrics(batch) -> None:
+        from ..features.batch import wire_nbytes
+
+        reg = _metrics.get_registry()
+        reg.counter("pipeline.batches").inc()
+        reg.counter("pipeline.tweets").inc(batch.num_valid)
+        reg.counter("wire.bytes").inc(wire_nbytes(batch))
+
+    def _featurize_impl(self, statuses: list) -> "FeatureBatch | UnitBatch":
         from ..features.blocks import ParsedBlock, merge_blocks
 
         if statuses and isinstance(statuses[0], ParsedBlock):
@@ -213,6 +242,7 @@ class FeatureStream(RawStream):
     ) -> "FeatureBatch | UnitBatch":
         batch = self._featurize(statuses)
         self._check_buckets(batch)
+        self._record_metrics(batch)
         for fn in self._outputs:
             fn(batch, batch_time)
         return batch
@@ -272,7 +302,18 @@ class StreamingContext:
         exactly ``limit`` rows while data lasts, which multi-host lockstep
         requires (an overshooting block would grow this host's program
         shape away from its peers') and which makes single-host
-        back-to-back block batches deterministic bucket-sized too."""
+        back-to-back block batches deterministic bucket-sized too.
+
+        Instrumented as the ``source_read`` stage when tracing is on."""
+        tr = _trace.get()
+        if not tr.enabled:
+            return self._drain_impl(limit)
+        with tr.span("source_read") as sp:
+            out = self._drain_impl(limit)
+            sp.add(items=len(out))
+        return out
+
+    def _drain_impl(self, limit: int = 0) -> list[Status]:
         out: list[Status] = []
         rows = 0
         while not limit or rows < limit:
@@ -397,6 +438,7 @@ class StreamingContext:
                 # last resort keeps alignment at the cost of the batch
                 log.error("overflow persists; dropping the whole batch")
                 batch = stream._featurize([])
+        stream._record_metrics(batch)
         for fn in stream._outputs:
             fn(batch, batch_time)
         self.batches_processed += 1

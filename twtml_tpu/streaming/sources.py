@@ -125,15 +125,36 @@ class ReplayFileSource(Source):
         self.speed = speed
         self.loop = loop
 
+    # tweets per aggregated ``parse`` span: per-line spans would swamp the
+    # trace at the ~1.2M tweets/s parse rate, so the source thread batches
+    # its parse time into one complete event per this many lines
+    PARSE_SPAN_EVERY = 1024
+
     def produce(self) -> Iterator[Status]:
+        from ..telemetry import trace as _trace
+
         while True:
             prev_ms: int | None = None
+            tr = _trace.get()
+            t_parse, n_parse = 0.0, 0
             with open(self.path, encoding="utf-8") as fh:
                 for line in fh:
                     line = line.strip()
                     if not line:
                         continue
-                    status = Status.from_json(json.loads(line))
+                    if tr.enabled:
+                        t0 = time.perf_counter()
+                        status = Status.from_json(json.loads(line))
+                        t_parse += time.perf_counter() - t0
+                        n_parse += 1
+                        if n_parse >= self.PARSE_SPAN_EVERY:
+                            tr.complete(
+                                "parse", time.perf_counter() - t_parse,
+                                t_parse, items=n_parse,
+                            )
+                            t_parse, n_parse = 0.0, 0
+                    else:
+                        status = Status.from_json(json.loads(line))
                     if self.speed > 0:
                         gap_ms = 10.0
                         if prev_ms and status.created_at_ms > prev_ms:
@@ -142,6 +163,11 @@ class ReplayFileSource(Source):
                         if self._stop.wait(gap_ms / 1000.0 / self.speed):
                             return
                     yield status
+            if n_parse:
+                tr.complete(
+                    "parse", time.perf_counter() - t_parse, t_parse,
+                    items=n_parse,
+                )
             if not self.loop:
                 return
 
@@ -173,7 +199,21 @@ class BlockParserMixin:
         return blocks
 
     def _parse(self, data: bytes):
-        """(ParsedBlock | None, carry bytes) for one buffered chunk."""
+        """(ParsedBlock | None, carry bytes) for one buffered chunk —
+        instrumented as the ``parse`` stage (one real span per chunk; the
+        block path parses MB-scale buffers, so per-chunk spans are cheap)."""
+        from ..telemetry import trace as _trace
+
+        tr = _trace.get()
+        if not tr.enabled:
+            return self._parse_impl(data)
+        with tr.span("parse", bytes=len(data)) as sp:
+            block, rest = self._parse_impl(data)
+            if block is not None:
+                sp.add(rows=int(block.rows))
+        return block, rest
+
+    def _parse_impl(self, data: bytes):
         from ..features import native
         from ..features.blocks import ParsedBlock
 

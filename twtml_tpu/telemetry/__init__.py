@@ -1,5 +1,9 @@
-from .api_types import Config, Stats, decode, encode
+from .api_types import Config, Metrics, Series, Stats, decode, encode
 from .web_client import WebClient
 from .session_stats import SessionStats
+from . import metrics, trace
 
-__all__ = ["Config", "Stats", "decode", "encode", "WebClient", "SessionStats"]
+__all__ = [
+    "Config", "Metrics", "Series", "Stats", "decode", "encode",
+    "WebClient", "SessionStats", "metrics", "trace",
+]

@@ -52,7 +52,25 @@ class Series:
     json_class = "Series"
 
 
-TYPES = {"Config": Config, "Stats": Stats, "Series": Series}
+@dataclass
+class Metrics:
+    """Pipeline metrics snapshot — an ADDITIVE message type (no reference
+    equivalent) carrying the process-local registry (telemetry/metrics.py)
+    and the tunnel-health summary to the dashboard's observability panel.
+    Rides the jsonClass-discriminated wire like Series, so legacy dashboards
+    ignore it. ``counters``/``gauges`` are flat name→value maps; ``health``
+    is TunnelHealthMonitor.summary() (phase, rtt_ms, transitions,
+    observations)."""
+
+    counters: dict = field(default_factory=dict)
+    gauges: dict = field(default_factory=dict)
+    health: dict = field(default_factory=dict)
+
+    json_class = "Metrics"
+
+
+TYPES = {"Config": Config, "Stats": Stats, "Series": Series,
+         "Metrics": Metrics}
 
 
 def encode(obj: Config | Stats) -> str:

@@ -12,6 +12,8 @@ from __future__ import annotations
 import numpy as np
 
 from ..utils import get_logger, round_half_up
+from . import metrics as _metrics
+from . import trace as _trace
 from .lightning import CHART_MAX_POINTS, Lightning, Visualization
 from .web_client import WebClient
 
@@ -20,6 +22,11 @@ log = get_logger("telemetry.session")
 # per-batch cap on chart series points shipped to the dashboard (shared
 # with every streaming chart — telemetry/lightning.py)
 SERIES_MAX_POINTS = CHART_MAX_POINTS
+
+# publish a pipeline-metrics snapshot every N stats updates: counters move
+# every batch but the dashboard panel doesn't need per-batch resolution,
+# and each publish is one more best-effort HTTP POST on the hot path
+METRICS_EVERY = 8
 
 # SessionStats.scala:15-20
 REAL_COLOR_DET = [173.0, 216.0, 230.0]  # light blue
@@ -34,6 +41,7 @@ class SessionStats:
         self.lgn = Lightning(host=conf.lightning)
         self.web = WebClient(conf.twtweb)
         self.viz: Visualization | None = None
+        self._updates = 0
 
     def open(self) -> "SessionStats":
         log.info("Initializing plot on lightning server: %s", self.conf.lightning)
@@ -75,6 +83,16 @@ class SessionStats:
         """Push one batch of stats — same call shape as SessionStats.update
         (SessionStats.scala:22-34); mse/stdevs arrive already HALF_UP-rounded
         and are truncated to int for the dashboard like ``.toLong``."""
+        tr = _trace.get()
+        if not tr.enabled:
+            self._update(count, batch, mse, real_stdev, pred_stdev, real, pred)
+            return
+        with tr.span("stats_publish", batch=int(batch)):
+            self._update(count, batch, mse, real_stdev, pred_stdev, real, pred)
+
+    def _update(
+        self, count, batch, mse, real_stdev, pred_stdev, real, pred
+    ) -> None:
         stats_ok = True
         try:
             self.web.stats(count, batch, int(mse), int(real_stdev), int(pred_stdev))
@@ -103,3 +121,18 @@ class SessionStats:
                 )
             except Exception:
                 log.debug("lightning append failed", exc_info=True)
+        self._updates += 1
+        if self._updates % METRICS_EVERY == 0:
+            self.publish_metrics()
+
+    def publish_metrics(self) -> None:
+        """Best-effort push of the process metrics registry + tunnel-health
+        summary to the dashboard's observability panel (/api/metrics)."""
+        try:
+            snap = _metrics.get_registry().snapshot()
+            self.web.metrics(
+                snap["counters"], snap["gauges"],
+                _metrics.get_health_monitor().summary(),
+            )
+        except Exception:
+            log.debug("web.metrics failed", exc_info=True)

@@ -1,0 +1,233 @@
+"""Pipeline tracing: Chrome-trace-event JSONL spans over the per-batch stages.
+
+The r2/r3 bottleneck ladder (tunnel uploads > host parse > host featurize >
+device step) was reconstructed by hand from ad-hoc bench scripts; a ``--trace
+PATH`` run writes it directly: every stage of every batch becomes a span
+carrying bytes-on-wire, batch size, and fetch depth, so
+``tools/trace_report.py`` (or Perfetto) reproduces the per-stage time budget
+from the file alone.
+
+File format: the Chrome JSON **array** trace format, written incrementally —
+a ``[`` line followed by one complete event object per line (trailing
+comma). The spec makes the closing ``]`` optional exactly so writers can
+append and crashes lose nothing, which also makes the file line-parseable as
+JSONL after stripping the decoration (``tools/trace_report.py`` does). Loads
+as-is in Perfetto / ``chrome://tracing``.
+
+Measurement-integrity constraints (BENCHMARKS.md): tracing adds **no**
+``device_get``/``block_until_ready`` calls and no non-main-thread
+``device_put`` — spans only time work the pipeline already does. Off is the
+default and must stay ~free on the hot path: ``get()`` returns a null tracer
+whose ``enabled`` is False and whose ``span()`` hands back one shared no-op
+context manager — instrumentation sites guard-check ``enabled`` before doing
+any argument computation.
+
+Threading: spans are written from the main thread AND the fetch pool
+(apps/common.FetchPipeline) — one lock around the line write keeps events
+intact; ``tid`` records the emitting thread so Perfetto lanes stay honest.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+
+from ..utils import get_logger
+
+log = get_logger("telemetry.trace")
+
+# the per-batch pipeline stages (the instrumentation contract — tests and
+# trace_report key on these names)
+STAGES = (
+    "source_read",   # queue drain on the batch scheduler
+    "parse",         # bytes/lines → Status/ParsedBlock, on the source thread
+    "featurize",     # host featurize incl. wire build (FeatureStream)
+    "wire_pack",     # one-buffer pack of the ragged wire (when --wire ragged)
+    "dispatch",      # model.step dispatch — argument uploads ride this
+    "fetch",         # pipelined StepOutput host fetch (FetchPipeline pool)
+    "stats_publish", # telemetry POSTs (SessionStats)
+)
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _NullTrace:
+    """The off-by-default tracer: every operation is a guard-checked no-op."""
+
+    enabled = False
+    path = ""
+
+    def span(self, name, **args):
+        return _NULL_SPAN
+
+    def complete(self, name, t0_s, dur_s, **args):
+        pass
+
+    def instant(self, name, **args):
+        pass
+
+    def counter(self, name, **values):
+        pass
+
+    def close(self):
+        pass
+
+
+_NULL = _NullTrace()
+
+
+class _Span:
+    """Context manager recording one complete ("X") event on exit."""
+
+    __slots__ = ("_trace", "_name", "_args", "_t0")
+
+    def __init__(self, trace: "PipelineTrace", name: str, args: dict):
+        self._trace = trace
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def add(self, **args) -> None:
+        """Attach args discovered mid-span (e.g. rows known only after
+        featurize returns)."""
+        self._args.update(args)
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self._args["error"] = exc_type.__name__
+        self._trace.complete(
+            self._name, self._t0, time.perf_counter() - self._t0,
+            **self._args,
+        )
+        return False
+
+
+class PipelineTrace:
+    """Chrome-trace-event writer. ``ts`` is ``time.perf_counter`` µs (one
+    monotonic timebase across threads); writes are line-buffered so a crash
+    loses at most the event being formatted."""
+
+    enabled = True
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._pid = os.getpid()
+        # buffering=1: every event line reaches the OS immediately — the
+        # crash-flush guarantee without an explicit flush per event
+        self._fh = open(path, "w", encoding="utf-8", buffering=1)
+        self._fh.write("[\n")
+        self._event(
+            {"name": "process_name", "ph": "M", "pid": self._pid, "tid": 0,
+             "args": {"name": "twtml-tpu pipeline"}}
+        )
+
+    # -- event plumbing ------------------------------------------------------
+    def _event(self, ev: dict) -> None:
+        line = json.dumps(ev, separators=(",", ":"))
+        with self._lock:
+            if self._fh.closed:
+                return
+            self._fh.write(line + ",\n")
+
+    def _base(self, name: str) -> dict:
+        return {
+            "name": name,
+            "cat": "pipeline",
+            "pid": self._pid,
+            "tid": threading.get_ident() & 0xFFFFFFFF,
+        }
+
+    # -- public API ----------------------------------------------------------
+    def span(self, name: str, **args) -> _Span:
+        """``with trace.span("featurize", rows=...):`` — one complete event
+        spanning the with-block. Nest freely; Chrome's viewer nests X events
+        by time containment per thread."""
+        return _Span(self, name, args)
+
+    def complete(self, name: str, t0_s: float, dur_s: float, **args) -> None:
+        """Record a complete event from an already-taken (start, duration)
+        pair — for call sites that need the duration themselves (the fetch
+        wrapper feeds it to the health monitor too)."""
+        ev = self._base(name)
+        ev["ph"] = "X"
+        ev["ts"] = round(t0_s * 1e6, 1)
+        ev["dur"] = round(dur_s * 1e6, 1)
+        if args:
+            ev["args"] = args
+        self._event(ev)
+
+    def instant(self, name: str, **args) -> None:
+        """Zero-duration mark (health-phase transitions)."""
+        ev = self._base(name)
+        ev["ph"] = "i"
+        ev["ts"] = round(time.perf_counter() * 1e6, 1)
+        ev["s"] = "p"  # process-scoped mark
+        if args:
+            ev["args"] = args
+        self._event(ev)
+
+    def counter(self, name: str, **values) -> None:
+        """Chrome counter track (e.g. fetch queue depth over time)."""
+        ev = self._base(name)
+        ev["ph"] = "C"
+        ev["ts"] = round(time.perf_counter() * 1e6, 1)
+        ev["args"] = values
+        self._event(ev)
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._fh.closed:
+                self._fh.close()
+
+
+# -- module-level active tracer ---------------------------------------------
+# One active tracer per process, installed by the app entry points from
+# ``--trace PATH``. Instrumentation sites call ``get()`` and guard on
+# ``.enabled`` — with no tracer installed that is one attribute read.
+
+_active: "PipelineTrace | _NullTrace" = _NULL
+
+
+def install(path: str) -> "PipelineTrace | _NullTrace":
+    """Activate tracing to ``path`` (empty path → stays off). Closes any
+    previously installed tracer; registered atexit so a crash still flushes
+    and closes the file."""
+    global _active
+    if not path:
+        return _active
+    if _active.enabled:
+        _active.close()
+    _active = PipelineTrace(path)
+    atexit.register(_active.close)
+    log.info("pipeline trace → %s (Perfetto-loadable)", path)
+    return _active
+
+
+def uninstall() -> None:
+    """Deactivate and close the active tracer (app shutdown path)."""
+    global _active
+    if _active.enabled:
+        _active.close()
+    _active = _NULL
+
+
+def get() -> "PipelineTrace | _NullTrace":
+    return _active

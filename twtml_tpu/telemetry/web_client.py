@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import urllib.request
 
-from .api_types import Config, Series, Stats, decode, encode
+from .api_types import Config, Metrics, Series, Stats, decode, encode
 
 DEFAULT_SERVER = "http://localhost:8888"  # WebClient.scala:13
 
@@ -64,6 +64,12 @@ class WebClient:
                 predStddev=float(pred_stddev),
             )
         )
+
+    def metrics(self, counters: dict, gauges: dict, health: dict) -> None:
+        """Push a pipeline-metrics snapshot for the dashboard's
+        observability panel (additive message; telemetry/metrics.py)."""
+        self._post(Metrics(counters=dict(counters), gauges=dict(gauges),
+                           health=dict(health)))
 
     # -- reads (WebClient.scala:40-46) ---------------------------------------
     def get_config(self) -> Config:

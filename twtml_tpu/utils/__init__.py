@@ -1,10 +1,15 @@
 from .rounding import round_half_up
 from .logging import get_logger
-from .backend import force_virtual_cpu_devices, set_cpu_device_count_hint
+from .backend import (
+    force_virtual_cpu_devices,
+    set_cpu_device_count_hint,
+    shard_map,
+)
 
 __all__ = [
     "round_half_up",
     "get_logger",
     "force_virtual_cpu_devices",
     "set_cpu_device_count_hint",
+    "shard_map",
 ]

@@ -29,6 +29,90 @@ def backends_initialized() -> bool | None:
         return None
 
 
+def shard_map():
+    """The shard_map entry point across jax versions: top-level
+    ``jax.shard_map`` where it exists (newer jax), the experimental module
+    otherwise (the 0.4.3x line) — same keyword surface
+    (``mesh``/``in_specs``/``out_specs``) either way."""
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _sm
+
+    # check_rep=False: the 0.4.x replication checker false-positives on the
+    # scan-carry + psum pattern our superbatch programs use ("mismatched
+    # replication types"); it is a static lint, not a semantic change, and
+    # later jax versions accept the same programs with checking on
+    return functools.partial(_sm, check_rep=False)
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis from inside a shard_map body, across
+    jax versions: ``lax.axis_size`` where it exists, the axis environment on
+    the 0.4.3x line. Always a Python int (shape arithmetic depends on it)."""
+    from jax import lax
+
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    from jax._src import core
+
+    return int(core.get_axis_env().axis_sizes[axis_name])
+
+
+def pcast_varying(x, axis_name):
+    """``lax.pcast(..., to="varying")`` where it exists (the new shard_map
+    varying-manual-axes system); identity on older jax, whose experimental
+    shard_map (run with ``check_rep=False`` — see ``shard_map``) has no
+    replication types to convert between."""
+    from jax import lax
+
+    fn = getattr(lax, "pcast", None)
+    if fn is None:
+        return x
+    return fn(x, axis_name, to="varying")
+
+
+def set_host_device_count_flag(n_devices: int) -> None:
+    """Pre-init fallback for jax builds without the ``jax_num_cpu_devices``
+    config option (it landed after the 0.4.3x line this image may carry):
+    the classic ``XLA_FLAGS --xla_force_host_platform_device_count`` route,
+    which the CPU backend reads at initialization. Replaces any existing
+    count flag so repeated calls converge instead of appending."""
+    import os
+
+    flag = f"--xla_force_host_platform_device_count={n_devices}"
+    parts = [
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    parts.append(flag)
+    os.environ["XLA_FLAGS"] = " ".join(parts)
+
+
+def _set_cpu_device_count(n_devices: int) -> bool:
+    """``jax_num_cpu_devices`` when this jax has it, XLA_FLAGS otherwise.
+    Returns False when a live backend makes the change impossible."""
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+        return True
+    except AttributeError:
+        # older jax: no such config option — the env-var route below works
+        # as long as no backend is initialized (callers checked)
+        set_host_device_count_flag(n_devices)
+        return True
+    except RuntimeError:
+        # probe was unavailable and a backend with a different CPU device
+        # count is already live
+        return False
+
+
 def force_virtual_cpu_devices(n_devices: int) -> bool:
     """Switch jax to an ``n_devices``-device virtual CPU backend.
 
@@ -44,26 +128,16 @@ def force_virtual_cpu_devices(n_devices: int) -> bool:
 
     if backends_initialized():
         return False
-    try:
-        jax.config.update("jax_num_cpu_devices", n_devices)
-        jax.config.update("jax_platforms", "cpu")
-        return True
-    except RuntimeError:
-        # probe was unavailable and a backend with a different CPU device
-        # count is already live
+    if not _set_cpu_device_count(n_devices):
         return False
+    jax.config.update("jax_platforms", "cpu")
+    return True
 
 
 def set_cpu_device_count_hint(n_devices: int) -> bool:
     """Set the CPU device count without forcing the platform (the local[N]
     hint: only affects runs where the CPU backend wins platform selection).
     Returns False if a backend is already initialized, leaving it untouched."""
-    import jax
-
     if backends_initialized():
         return False
-    try:
-        jax.config.update("jax_num_cpu_devices", n_devices)
-        return True
-    except RuntimeError:
-        return False
+    return _set_cpu_device_count(n_devices)

@@ -43,6 +43,15 @@ def _usable_cpus() -> int:
 def _run_once(model, featurize, chunks, prefetch: bool):
     """One timed pass; returns (elapsed seconds, last StepOutput). Dispatch
     freely, one real fetch at the end — see the module docstring."""
+    dt, last, _ = _run_once_timed(model, featurize, chunks, prefetch)
+    return dt, last
+
+
+def _run_once_timed(model, featurize, chunks, prefetch: bool):
+    """``_run_once`` plus the completion-fetch seconds as a third element —
+    the fetch is timed separately so the tunnel-health monitor can classify
+    the pass (telemetry/metrics.py): a stalled transport shows up as a
+    multi-second completion fetch."""
     t0 = time.perf_counter()
     if prefetch:
         with ThreadPoolExecutor(max_workers=1) as pool:
@@ -56,8 +65,10 @@ def _run_once(model, featurize, chunks, prefetch: bool):
         last = None
         for chunk in chunks:
             last = model.step(featurize(chunk))
+    t_fetch = time.perf_counter()
     float(last.mse)  # force completion inside the timed window
-    return time.perf_counter() - t0, last
+    t_end = time.perf_counter()
+    return t_end - t0, last, t_end - t_fetch
 
 
 def measure_passes(
@@ -139,10 +150,19 @@ def measure_pipeline(
         # before the first timed pass (module docstring)
         float(model.step(warm).mse)
 
+    # per-pass health classification: the completion-fetch latency is the
+    # pass's transport sample; phase counts in the output say how much of
+    # the budget sat in a degraded window (the tunnel's ~10-min phases)
+    from ..telemetry.metrics import TunnelHealthMonitor
+
+    health = TunnelHealthMonitor()
+
     def run_pass():
         if resettable:
             model.reset()
-        return _run_once(model, featurize, chunks, prefetch)
+        dt, last, fetch_s = _run_once_timed(model, featurize, chunks, prefetch)
+        health.observe(fetch_s)
+        return dt, last
 
     best_dt, last, times = measure_passes(
         run_pass,
@@ -158,4 +178,5 @@ def measure_pipeline(
         "batches": len(chunks),
         "final_mse": float(last.mse),  # identical across passes w/ reset()
         "passes": len(times),
+        "health": health.summary(),
     }

@@ -68,6 +68,14 @@ class RssWatchdog:
             return
         cur = rss_mb()
         self.last_mb = cur
+        try:
+            # observability side-channel: the per-N-batches RSS sample lands
+            # in the metrics registry so the dashboard/bench see it live
+            from ..telemetry import metrics as _metrics
+
+            _metrics.get_registry().gauge("host.rss_mb").set(round(cur, 1))
+        except Exception:
+            pass
         if self._base is None:
             self._base = cur
             return

@@ -30,10 +30,33 @@
     }
   }
 
+  function onMetrics(json) {
+    // pipeline observability panel (telemetry/metrics.py snapshot)
+    const counters = json.counters || {};
+    const gauges = json.gauges || {};
+    const health = json.health || {};
+    const phase = health.phase || "—";
+    const badge = document.getElementById("tunnelPhase");
+    badge.textContent = phase;
+    badge.classList.toggle("healthy", phase === "healthy");
+    badge.classList.toggle("degraded", phase === "degraded");
+    document.getElementById("rttMs").textContent =
+      String(health.rtt_ms || 0);
+    document.getElementById("phaseFlips").textContent =
+      String(health.transitions || 0);
+    document.getElementById("wireMb").textContent =
+      (Number(counters["wire.bytes"] || 0) / 1e6).toFixed(1);
+    document.getElementById("rssMb").textContent =
+      String(gauges["host.rss_mb"] || 0);
+    document.getElementById("fetchDepth").textContent =
+      String(gauges["fetch.queue_depth"] || 0);
+  }
+
   function onMessage(json) {
     switch (json.jsonClass) {
       case "Config": onConfig(json); break;
       case "Stats": onStats(json); break;
+      case "Metrics": onMetrics(json); break;
       case "Series":
         // live frames buffer until the history backfill lands (ordering)
         if (!backfilled) pendingSeries.push(json);
@@ -54,6 +77,8 @@
     api.bind(onMessage);
     api.websocketOn();
     api.getStats().then(onStats).catch(() => {});
+    // observability panel backfill (latest Metrics snapshot, if any)
+    fetch("/api/metrics").then((r) => r.json()).then(onMetrics).catch(() => {});
     // backfill the chart from the server's rolling series window, then
     // apply any live frames that arrived while the fetch was in flight
     const flush = () => {

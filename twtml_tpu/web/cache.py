@@ -16,7 +16,7 @@ import tempfile
 import collections
 import json as _json
 
-from ..telemetry.api_types import Config, Series, Stats, decode, encode
+from ..telemetry.api_types import Config, Metrics, Series, Stats, decode, encode
 from ..utils import get_logger
 
 log = get_logger("web.cache")
@@ -32,6 +32,7 @@ class ApiCache:
         self.backup_file = backup_file
         self._stats = Stats()
         self._config = Config()
+        self._metrics = Metrics()
         self._series: collections.deque[Series] = collections.deque(
             maxlen=SERIES_WINDOW
         )
@@ -41,6 +42,10 @@ class ApiCache:
 
     def stats(self) -> str:
         return encode(self._stats)
+
+    def metrics(self) -> str:
+        """Latest pipeline-metrics snapshot (in-memory only, like Stats)."""
+        return encode(self._metrics)
 
     def series(self) -> str:
         """Recent Series messages as a JSON array (chart backfill for
@@ -64,6 +69,8 @@ class ApiCache:
         if isinstance(data, Stats):
             log.debug("caching stats")
             self._stats = data
+        elif isinstance(data, Metrics):
+            self._metrics = data
         elif isinstance(data, Series):
             self._series.append(data)
         else:
