@@ -42,10 +42,15 @@ def main() -> None:
         f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid
     )
 
-    if mesh_kind == "lockstep_abort":
-        # the anti-hang machinery: host 1's batch handler raises mid-run;
-        # its loop must broadcast abort so host 0 STOPS (instead of
-        # stalling in its next collective), and BOTH mark the run failed
+    if mesh_kind in ("lockstep_abort", "peer_kill"):
+        # the anti-hang machinery. lockstep_abort: host 1's batch handler
+        # raises mid-run; its loop must broadcast abort so host 0 STOPS
+        # (instead of stalling in its next collective), and BOTH mark the
+        # run failed. peer_kill: host 1 dies HARD (os._exit — no abort
+        # broadcast, no goodbye); host 0's next cadence allgather can then
+        # never complete, and the lockstep peer watchdog
+        # (TWTML_LOCKSTEP_TIMEOUT_S) must turn that into a loud failed
+        # abort rather than an infinite collective hang.
         from twtml_tpu.features.featurizer import Featurizer
         from twtml_tpu.parallel import ParallelSGDModel, make_mesh
         from twtml_tpu.parallel.distributed import host_local_batch_to_global
@@ -70,6 +75,11 @@ def main() -> None:
             seen["n"] += 1
             model.step(host_local_batch_to_global(batch, mesh))
             if pid == 1 and seen["n"] == 3:
+                if mesh_kind == "peer_kill":
+                    # hard kill AFTER this tick's dispatch: the peer's
+                    # tick-3 collectives complete, so the hang host 0 must
+                    # survive is the NEXT cadence allgather
+                    os._exit(42)
                 # post-dispatch handler failure: the recoverable class —
                 # this host's collective program DID run, so the peer's
                 # collectives complete and the abort flag can reach it on
@@ -88,6 +98,15 @@ def main() -> None:
             "failed": bool(ssc.failed),
             "batches_seen": seen["n"],
         }), flush=True)
+        if mesh_kind == "peer_kill":
+            # with a hard-dead peer, jax.distributed's atexit shutdown
+            # barrier can never complete — its client FATALs the process
+            # (SIGABRT) after the coordination-service timeout. The
+            # watchdog behavior under test is fully reported above, so
+            # skip the doomed barrier. (A real app exits non-zero via its
+            # RuntimeError in exactly this state.)
+            sys.stdout.flush()
+            os._exit(0)
         return
 
     import numpy as np

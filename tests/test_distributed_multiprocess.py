@@ -414,6 +414,40 @@ def test_lockstep_abort_propagates_instead_of_hanging():
     assert by_pid[1]["batches_seen"] == 3  # raised on its third batch
 
 
+def test_lockstep_peer_death_watchdog_aborts_survivor():
+    """A HARD-killed peer (os._exit mid-run: no abort broadcast, no
+    goodbye) must not leave the survivor hanging forever in its next
+    cadence allgather: the lockstep peer watchdog
+    (TWTML_LOCKSTEP_TIMEOUT_S) — or the transport error a dead gloo peer
+    raises — turns it into a loud failed abort within the timeout."""
+    port = _free_port()
+    env = dict(
+        os.environ, PYTHONPATH=REPO, TWTML_LOCKSTEP_TIMEOUT_S="5",
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(i), "2", str(port), "unit",
+             "peer_kill"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    try:
+        out0, err0 = procs[0].communicate(timeout=120.0)
+        out1, _ = procs[1].communicate(timeout=120.0)
+    finally:
+        for p in procs:
+            p.kill()
+    assert procs[1].returncode == 42  # the hard kill
+    assert out1.strip() == ""  # it never got to print
+    assert procs[0].returncode == 0, f"survivor crashed:\n{err0[-3000:]}"
+    res = json.loads(out0.strip().splitlines()[-1])
+    assert res["terminated"], "survivor never left the lockstep loop"
+    assert res["failed"], "survivor did not mark the run failed"
+    assert res["batches_seen"] >= 3  # it trained up to the kill point
+
+
 def test_app_level_multihost_wall_clock_intervals(tmp_path):
     """The lockstep scheduler's WALL-CLOCK branch (--seconds > 0): hosts
     tick on their own clocks, the per-tick allgather aligns them, and the

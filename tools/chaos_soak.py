@@ -1,0 +1,133 @@
+"""Chaos soak: drive the flagship replay app back-to-back under the
+transport fault injector (streaming/faults.ChaosInjector) and assert the
+runtime guards hold up over time — the app-level companion of the unit
+chaos tests (tests/test_chaos.py) and the endurance soaks (tools/soak.py).
+
+Each round replays the same synthetic corpus through the full linear app
+(FetchPipeline, checkpoints, telemetry) with chaos active on all three
+injection points: fetch latency spikes + occasional fetch errors (the
+watchdog's re-issue path), dispatch delays, and a flaky dashboard (the
+publish circuit breaker's open/half-open cycle — the twtweb endpoint is a
+closed port, so un-dropped publishes also fail fast). The run must
+SURVIVE: every round trains the full corpus, counters prove the guards
+fired (retries > 0, breaker failures > 0), and zero fetch aborts occur.
+
+Usage: python tools/chaos_soak.py [--minutes M] [--tweets N] [--chaos SPEC]
+Prints one JSON line at the end; exits non-zero on any violated invariant.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# survivable defaults: delays well under the fetch deadline, errors rare
+# enough that the retry budget (3) never exhausts on one batch, a mostly
+# dead dashboard to cycle the breaker through open/half-open/probe.
+# Triggers sized to the default round (16384 tweets / 2048 = 8 batches —
+# each round re-installs the injector, resetting its call counters).
+DEFAULT_CHAOS = (
+    "fetch:delay=0.5@5,fetch:error@7,step:delay=0.1@3,"
+    "web:error@p0.8,seed=3"
+)
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    minutes, n_tweets, chaos = 10.0, 16384, DEFAULT_CHAOS
+    i = 0
+    while i < len(args):
+        if args[i] == "--minutes":
+            minutes = float(args[i + 1]); i += 2
+        elif args[i] == "--tweets":
+            n_tweets = int(args[i + 1]); i += 2
+        elif args[i] == "--chaos":
+            chaos = args[i + 1]; i += 2
+        else:
+            raise SystemExit(f"unknown flag {args[i]!r}")
+
+    from tools.bench_suite import _status_json
+    from twtml_tpu.apps import linear_regression as app
+    from twtml_tpu.config import ConfArguments
+    from twtml_tpu.streaming.sources import SyntheticSource
+    from twtml_tpu.telemetry import metrics as _metrics
+
+    tmp = tempfile.mkdtemp(prefix="chaos-soak-")
+    replay = os.path.join(tmp, "tweets.jsonl")
+    with open(replay, "w") as fh:
+        for s in SyntheticSource(
+            total=n_tweets, seed=5, base_ms=1785320000000
+        ).produce():
+            fh.write(json.dumps(_status_json(s)) + "\n")
+
+    closed = "http://127.0.0.1:9"  # closed port: fails fast when attempted
+    conf_args = [
+        "--source", "replay", "--replayFile", replay,
+        "--seconds", "0", "--batchBucket", "2048", "--tokenBucket", "512",
+        "--checkpointDir", os.path.join(tmp, "ck"), "--checkpointEvery", "4",
+        "--lightning", closed, "--twtweb", closed,
+        "--webTimeout", "0.5",
+        "--chaos", chaos,
+    ]
+
+    deadline = time.time() + minutes * 60.0
+    rounds, tweets, failures = 0, 0, []
+    t0 = time.time()
+    while time.time() < deadline:
+        totals = app.run(ConfArguments().parse(list(conf_args)))
+        rounds += 1
+        # counters resume from the checkpoint each round, so check deltas
+        if totals["count"] - tweets != n_tweets:
+            failures.append(
+                f"round {rounds} trained {totals['count'] - tweets} "
+                f"of {n_tweets} tweets"
+            )
+            break
+        tweets = totals["count"]
+
+    reg = _metrics.get_registry().snapshot()
+    counters = reg["counters"]
+    aborts = counters.get("fetch.aborts", 0)
+    retries = counters.get("fetch.retries", 0)
+    injected = counters.get("chaos.injected", 0)
+    fetch_errors = counters.get("chaos.fetch.errors", 0)
+    breaker_failures = counters.get("publish.web.failures", 0)
+    if aborts:
+        failures.append(f"{aborts} fetch abort(s) under survivable chaos")
+    if not injected:
+        failures.append("chaos injector never fired")
+    if fetch_errors and retries < fetch_errors:
+        # every injected fetch error must have been absorbed by a re-issue
+        failures.append(
+            f"{fetch_errors} injected fetch error(s) but only "
+            f"{retries} watchdog retries"
+        )
+
+    print(json.dumps({
+        "mode": "chaos-soak",
+        "minutes": round((time.time() - t0) / 60.0, 2),
+        "rounds": rounds,
+        "tweets": tweets,
+        "chaos": chaos,
+        "chaos_injected": injected,
+        "fetch_retries": retries,
+        "fetch_aborts": aborts,
+        "publish_failures": breaker_failures,
+        "publish_dropped": counters.get("publish.web.dropped", 0),
+        "series_shed": counters.get("publish.series_shed", 0),
+        "health": _metrics.get_health_monitor().summary(),
+        "failures": failures,
+        "ok": not failures,
+    }))
+    if failures:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -13,12 +13,26 @@ models/kmeans.py), with the CLI face unchanged.
 from __future__ import annotations
 
 from ..models.linear import StreamingLinearRegressionWithSGD
+from ..streaming import faults as _faults
 from ..streaming.sources import ReplayFileSource, Source, SyntheticSource
 from ..telemetry import metrics as _metrics
 from ..telemetry import trace as _trace
 from ..utils import get_logger
 
 log = get_logger("apps.common")
+
+# fetch-watchdog policy (see FetchWatchdog): the deadline derives from the
+# health monitor's rolling fetch RTT — generous multiples, because tunnel
+# stalls legitimately burst for minutes and a re-issue only helps a LOST
+# request, not a stalled transport
+FETCH_DEADLINE_MULT = 25.0
+FETCH_DEADLINE_MIN_S = 30.0
+FETCH_DEADLINE_MAX_S = 180.0
+FETCH_RETRIES = 3
+
+
+class FetchAbort(RuntimeError):
+    """The fetch watchdog exhausted its retries: the run is aborting."""
 
 
 def init_distributed(conf) -> bool:
@@ -101,6 +115,22 @@ def install_trace(conf) -> None:
     if jax.process_count() > 1:
         path = f"{path}.p{jax.process_index()}"
     _trace.install(path)
+
+
+def install_chaos(conf) -> None:
+    """``--chaos SPEC`` wiring shared by every entry point: activate the
+    seeded transport-fault injector (streaming/faults.py) over the
+    fetch/step/web injection points. Multi-host note: injections are
+    PER-HOST (each process parses the same spec with its own call
+    counters); a step error on one host exercises the lockstep abort
+    machinery exactly like a real host-local failure."""
+    spec = getattr(conf, "chaos", "")
+    if not spec:
+        return
+    try:
+        _faults.install_chaos(spec)
+    except ValueError as exc:
+        raise SystemExit(f"bad --chaos spec: {exc}")
 
 
 def build_source(
@@ -531,6 +561,100 @@ class ProcessRecycler:
         _os.execv(_sys.executable, argv)
 
 
+class FetchWatchdog:
+    """Deadline + bounded-retry + clean-abort guard over the pooled host
+    fetches (FetchPipeline / SuperBatcher).
+
+    Why it is safe to retry: a ``device_get`` through this transport is an
+    RTT-bound REQUEST, not a wait-for-arrival (BENCHMARKS.md r3) — the
+    device arrays stay resident, so a fetch that missed its deadline or
+    raised can simply be RE-ISSUED; a duplicate concurrent get reads the
+    same bytes. The deadline derives from the health monitor's rolling
+    fetch RTT (``FETCH_DEADLINE_MULT`` × median, clamped to
+    [``FETCH_DEADLINE_MIN_S``, ``FETCH_DEADLINE_MAX_S``]) — deliberately
+    generous, because tunnel stalls legitimately burst for minutes and a
+    retry only helps a LOST request, not a stalled transport.
+
+    After ``retries`` re-issues the run aborts CLEANLY instead of the
+    pre-guard behavior (an untimed ``future.result()`` = a silent permanent
+    hang): the abort hook marks the run failed and stops the stream, the
+    app's shutdown path flushes a final checkpoint, and the process exits
+    non-zero with a critical log line.
+
+    Env overrides (ops/test hooks): ``TWTML_FETCH_DEADLINE_S`` pins a fixed
+    deadline; ``TWTML_FETCH_RETRIES`` overrides the retry budget.
+    Constructor args win over both."""
+
+    def __init__(self, health, abort=None, deadline_s: float = 0.0,
+                 retries: "int | None" = None):
+        import os as _os
+
+        self._health = health
+        self._abort = abort
+        self.deadline_s = deadline_s or float(
+            _os.environ.get("TWTML_FETCH_DEADLINE_S", "0") or 0
+        )
+        self.retries = (
+            retries if retries is not None
+            else int(_os.environ.get("TWTML_FETCH_RETRIES", FETCH_RETRIES))
+        )
+        reg = _metrics.get_registry()
+        self._retry_count = reg.counter("fetch.retries")
+        self._abort_count = reg.counter("fetch.aborts")
+        self.aborted = False
+
+    def deadline(self) -> float:
+        if self.deadline_s > 0:
+            return self.deadline_s
+        med_s = self._health.median_ms() / 1e3
+        if med_s <= 0:
+            # no samples yet (first fetch of the run): be maximally patient
+            return FETCH_DEADLINE_MAX_S
+        return min(
+            max(FETCH_DEADLINE_MULT * med_s, FETCH_DEADLINE_MIN_S),
+            FETCH_DEADLINE_MAX_S,
+        )
+
+    def await_result(self, future, reissue):
+        """Blocking wait for a pooled fetch future under the deadline;
+        ``reissue()`` must submit a fresh fetch of the same device output
+        and return its future."""
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        attempts = 0
+        while True:
+            deadline = self.deadline()
+            try:
+                return future.result(timeout=deadline)
+            except _FutTimeout:
+                why = f"made no progress within its {deadline:.1f}s deadline"
+            except Exception as exc:
+                why = f"failed ({exc!r})"
+            attempts += 1
+            if attempts > self.retries:
+                self.aborted = True
+                self._abort_count.inc()
+                _trace.get().instant("fetch_abort", attempts=attempts)
+                log.critical(
+                    "pooled stats fetch %s after %d attempt(s); aborting "
+                    "the run — the stream stops and the shutdown path "
+                    "flushes a final checkpoint (FetchWatchdog)",
+                    why, attempts,
+                )
+                if self._abort is not None:
+                    self._abort()
+                raise FetchAbort(
+                    f"pooled fetch {why} after {attempts} attempts"
+                )
+            self._retry_count.inc()
+            log.warning(
+                "pooled stats fetch %s; re-issuing (retry %d/%d — a "
+                "device_get is an RTT-bound request, a duplicate is safe)",
+                why, attempts, self.retries,
+            )
+            future = reissue()
+
+
 class SuperBatcher:
     """Group K featurized micro-batches into ONE device dispatch
     (``model.step_many``: a lax.scan of the ordinary train step) and re-emit
@@ -573,7 +697,9 @@ class SuperBatcher:
 
     def __init__(self, model, k: int, handle, fetch_depth: int = 4,
                  boundary_every: int = 0, max_dispatch: int = 0,
-                 deterministic: bool = False):
+                 deterministic: bool = False, abort=None,
+                 fetch_deadline_s: float = 0.0,
+                 fetch_retries: "int | None" = None):
         from concurrent.futures import ThreadPoolExecutor
 
         self.model = model
@@ -605,9 +731,16 @@ class SuperBatcher:
             max_workers=self.fetch_depth,
             thread_name_prefix="twtml-group-fetch",
         )
+        # deadline/retry/abort guard over every pooled group fetch — the
+        # pre-guard future.result() was a silent permanent hang on a
+        # wedged tunnel (FetchWatchdog)
+        self._watchdog = FetchWatchdog(
+            self._health, abort=abort,
+            deadline_s=fetch_deadline_s, retries=fetch_retries,
+        )
         self._buf: list = []
         self._sig = None
-        self._inflight: list = []  # [(future, group)] oldest first
+        self._inflight: list = []  # [(future, group, outs)] oldest first
         self._dispatched = 0
         # checkpoint cadence runs on its own MONOTONIC counter, exactly as
         # in FetchPipeline: a refund_dispatch adjusts only the cap
@@ -627,6 +760,8 @@ class SuperBatcher:
         return (treedef,) + tuple((a.shape, str(a.dtype)) for a in leaves)
 
     def on_batch(self, batch, batch_time) -> None:
+        if self._watchdog.aborted:
+            return  # fetch abort in flight: nothing more may train
         if self.max_dispatch and self._dispatched >= self.max_dispatch:
             # cap reached: deliver what trained so the handler-side stop
             # fires (see FetchPipeline), train nothing more
@@ -643,8 +778,13 @@ class SuperBatcher:
     def _emit_group(self) -> None:
         from ..models.base import StepOutput
 
-        future, group = self._inflight.pop(0)
-        host = future.result()
+        future, group, outs = self._inflight.pop(0)
+        host = self._watchdog.await_result(
+            future,
+            lambda: self._pool.submit(
+                self._timed_fetch_many, outs, len(group)
+            ),
+        )
         last = len(group) - 1
         # _buf is provably empty at every emit site, so the pipeline being
         # drained is the whole weights-current condition
@@ -668,6 +808,8 @@ class SuperBatcher:
 
         fetch = self._fetch_many or jax.device_get
         t0 = _time.perf_counter()
+        _faults.perturb("fetch")  # --chaos: inside the timed window, so
+        # injected stalls feed the health monitor like real ones
         host = fetch(outs)
         dt = _time.perf_counter() - t0
         self._fetch_count.inc()
@@ -677,6 +819,26 @@ class SuperBatcher:
         if tr.enabled:
             tr.complete("fetch", t0, dt, depth=self.fetch_depth,
                         group=group_len)
+        return host
+
+    def _timed_fetch_one(self, out_dev):
+        """Single-batch pooled fetch (the partial-group path), timed like
+        ``_timed_fetch_many``."""
+        import time as _time
+
+        import jax
+
+        fetch = self._fetch_one or jax.device_get
+        t0 = _time.perf_counter()
+        _faults.perturb("fetch")
+        host = fetch(out_dev)
+        dt = _time.perf_counter() - t0
+        self._fetch_count.inc()
+        self._fetch_hist.observe(dt)
+        self._health.observe(dt)
+        tr = _trace.get()
+        if tr.enabled:
+            tr.complete("fetch", t0, dt, depth=1)
         return host
 
     def refund_dispatch(self) -> None:
@@ -703,26 +865,23 @@ class SuperBatcher:
             # Earlier groups must emit first (strict batch order), and the
             # max_dispatch cap binds here exactly like on full groups.
             self._drain()
-            import time as _time
-
             tr = _trace.get()
             for batch, t in group:
                 if self.max_dispatch and self._dispatched >= self.max_dispatch:
                     return
+                _faults.perturb("step")  # --chaos dispatch injection
                 if tr.enabled:
                     with tr.span("dispatch"):
                         out_dev = self.model.step(batch)
                 else:
                     out_dev = self.model.step(batch)
-                fetch = self._fetch_one or jax.device_get
-                t0 = _time.perf_counter()
-                out = fetch(out_dev)
-                dt = _time.perf_counter() - t0
-                self._fetch_count.inc()
-                self._fetch_hist.observe(dt)
-                self._health.observe(dt)
-                if tr.enabled:
-                    tr.complete("fetch", t0, dt, depth=1)
+                # same watchdog as the pooled paths (the fetch rides the
+                # pool so the deadline can fire; awaited immediately, so
+                # the partial path stays effectively synchronous)
+                out = self._watchdog.await_result(
+                    self._pool.submit(self._timed_fetch_one, out_dev),
+                    lambda: self._pool.submit(self._timed_fetch_one, out_dev),
+                )
                 self._dispatched += 1
                 self._cadence += 1
                 self.handle(out, batch, t, at_boundary=True)
@@ -735,6 +894,7 @@ class SuperBatcher:
             and self._inflight and self._inflight[0][0].done()
         ):
             self._emit_group()
+        _faults.perturb("step")  # --chaos dispatch injection
         tr = _trace.get()
         if tr.enabled:
             with tr.span("dispatch", group=len(group),
@@ -746,7 +906,7 @@ class SuperBatcher:
             outs = self.model.step_many(stack_batches([b for b, _ in group]))
         self._inflight.append(
             (self._pool.submit(self._timed_fetch_many, outs, len(group)),
-             group)
+             group, outs)
         )
         self._depth_gauge.set(len(self._inflight))
         self._dispatched += len(group)
@@ -758,9 +918,24 @@ class SuperBatcher:
             self._last_boundary = self._cadence
 
     def flush(self) -> None:
-        self._close_group()  # a partial tail drains inflight itself
-        self._drain()
-        self._pool.shutdown(wait=False)
+        try:
+            self._close_group()  # a partial tail drains inflight itself
+            self._drain()
+        except FetchAbort:
+            # already logged + the abort hook fired; the app's shutdown
+            # path owns the final checkpoint flush — never raise into it
+            if self._inflight or self._buf:
+                log.warning(
+                    "dropping %d in-flight group(s) and %d buffered "
+                    "batch(es) after the fetch abort",
+                    len(self._inflight), len(self._buf),
+                )
+                self._inflight.clear()
+                self._buf.clear()
+        finally:
+            # shutdown in a finally: an exception re-raised from
+            # future.result() during the drain must not leak the executor
+            self._pool.shutdown(wait=False)
 
 
 class FetchPipeline:
@@ -800,7 +975,9 @@ class FetchPipeline:
 
     def __init__(self, model, handle, depth: int = 8, stop_requested=None,
                  boundary_every: int = 0, max_dispatch: int = 0,
-                 pack: bool = False, deterministic: bool = False):
+                 pack: bool = False, deterministic: bool = False,
+                 abort=None, fetch_deadline_s: float = 0.0,
+                 fetch_retries: "int | None" = None):
         from concurrent.futures import ThreadPoolExecutor
 
         self.model = model
@@ -838,7 +1015,14 @@ class FetchPipeline:
         self._fetch_hist = self._registry.histogram("fetch.latency_s")
         self._depth_gauge = self._registry.gauge("fetch.queue_depth")
         self._refund_count = self._registry.counter("fetch.refunds")
-        self._pending: list = []  # [(future, batch, t)] oldest first
+        # deadline/retry/abort guard over every pooled fetch — the
+        # pre-guard future.result() in _emit_one was a silent permanent
+        # hang on a wedged tunnel (FetchWatchdog)
+        self._watchdog = FetchWatchdog(
+            self._health, abort=abort,
+            deadline_s=fetch_deadline_s, retries=fetch_retries,
+        )
+        self._pending: list = []  # [(future, out, batch, t)] oldest first
         self._dispatched = 0
         # checkpoint cadence runs on its own MONOTONIC counter: a
         # refund_dispatch must not make the cap accounting pass a cadence
@@ -857,6 +1041,8 @@ class FetchPipeline:
 
         fetch = self._fetch or jax.device_get
         t0 = _time.perf_counter()
+        _faults.perturb("fetch")  # --chaos: inside the timed window, so
+        # injected stalls feed the health monitor like real ones
         host = fetch(out)
         dt = _time.perf_counter() - t0
         self._fetch_count.inc()
@@ -868,10 +1054,11 @@ class FetchPipeline:
         return host
 
     def _emit_one(self) -> None:
-        future, batch, t = self._pending.pop(0)
-        self.handle(
-            future.result(), batch, t, at_boundary=not self._pending
+        future, out, batch, t = self._pending.pop(0)
+        host = self._watchdog.await_result(
+            future, lambda: self._pool.submit(self._timed_fetch, out)
         )
+        self.handle(host, batch, t, at_boundary=not self._pending)
 
     def _drain(self) -> None:
         while self._pending:
@@ -880,6 +1067,8 @@ class FetchPipeline:
     def on_batch(self, batch, t) -> None:
         import jax
 
+        if self._watchdog.aborted:
+            return  # fetch abort in flight: nothing more may train
         stop = self._stop_requested
         if stop is not None and stop():
             return  # stop requested: nothing more may train
@@ -912,6 +1101,7 @@ class FetchPipeline:
                 wire = packer(batch)
         else:
             wire = batch
+        _faults.perturb("step")  # --chaos dispatch injection
         if tr.enabled:
             # argument uploads ride the dispatch on this transport (no
             # separate device_put on the single-host hot path)
@@ -920,7 +1110,7 @@ class FetchPipeline:
         else:
             out = self.model.step(wire)  # dispatch on the MAIN thread
         self._pending.append(
-            (self._pool.submit(self._timed_fetch, out), batch, t)
+            (self._pool.submit(self._timed_fetch, out), out, batch, t)
         )
         self._depth_gauge.set(len(self._pending))
         self._dispatched += 1
@@ -940,12 +1130,25 @@ class FetchPipeline:
         self._refund_count.inc()
 
     def flush(self) -> None:
-        self._drain()
-        self._pool.shutdown(wait=False)
+        try:
+            self._drain()
+        except FetchAbort:
+            # already logged + the abort hook fired; the app's shutdown
+            # path owns the final checkpoint flush — never raise into it
+            if self._pending:
+                log.warning(
+                    "dropping %d undelivered batch output(s) after the "
+                    "fetch abort", len(self._pending),
+                )
+                self._pending.clear()
+        finally:
+            # shutdown in a finally: an exception re-raised from
+            # future.result() during the drain must not leak the executor
+            self._pool.shutdown(wait=False)
 
 
 def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
-                         max_dispatch: int = 0):
+                         max_dispatch: int = 0, abort=None):
     """Wire the app's per-batch ``handle(out, batch, t, at_boundary)`` to the
     stream: plain step-then-handle by default, grouped through a
     SuperBatcher when ``--superBatch K`` applies. Returns
@@ -1077,6 +1280,7 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
                 max_dispatch=max_dispatch,
                 pack=pack,
                 deterministic=multihost,
+                abort=abort,
             )
             if multihost:
                 pipeline_ref.append(pipe)  # empty-batch refunds (above)
@@ -1103,6 +1307,7 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
                     wire = packer(batch)
             else:
                 wire = batch
+            _faults.perturb("step")  # --chaos dispatch injection
             if tr.enabled:
                 with tr.span("dispatch"):
                     out = model.step(wire)
@@ -1110,6 +1315,7 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
                 out = model.step(wire)
             fetch = getattr(model, "fetch_output", None) or jax.device_get
             t0 = _time.perf_counter()
+            _faults.perturb("fetch")
             out = fetch(out)
             dt = _time.perf_counter() - t0
             reg = _metrics.get_registry()
@@ -1128,6 +1334,7 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
         boundary_every=boundary_every,
         max_dispatch=max_dispatch,
         deterministic=multihost,
+        abort=abort,
     )
     if multihost:
         pipeline_ref.append(batcher)  # empty-batch refunds (above)

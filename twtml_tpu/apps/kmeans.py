@@ -39,6 +39,7 @@ from .common import (
     build_mesh,
     build_source,
     init_distributed,
+    install_chaos,
     install_trace,
     select_backend,
 )
@@ -98,6 +99,7 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
     lead = init_distributed(conf)  # every entry point forms the group
     select_backend(conf)
     install_trace(conf)
+    install_chaos(conf)
     multihost = jax.process_count() > 1
     if multihost and conf.batchBucket <= 0:
         raise SystemExit(
@@ -323,8 +325,9 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
         ckpt.final_save(totals)
     if ssc.failed:
         raise RuntimeError(
-            "multi-host lockstep run aborted (see critical log above); "
-            "progress up to the failure is checkpointed"
+            "run aborted by a runtime guard — lockstep peer loss or a fetch "
+            "watchdog abort (see critical log above); progress up to the "
+            "failure is checkpointed"
         )
     return totals
 

@@ -32,6 +32,7 @@ from .common import (  # noqa: F401
     build_model,
     build_source,
     init_distributed,
+    install_chaos,
     install_trace,
     select_backend,
     warmup_compile,
@@ -57,6 +58,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
 
     lockstep = jax.process_count() > 1
     install_trace(conf)
+    install_chaos(conf)
 
     log.info("Initializing streaming context... %s sec/batch", conf.seconds)
     ssc = StreamingContext(batch_interval=conf.seconds)
@@ -123,6 +125,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         max_dispatch=(
             max(1, max_batches - totals["batches"]) if max_batches else 0
         ),
+        abort=ssc.request_abort,  # fetch-watchdog aborts fail the run loudly
     )
 
     warmup_compile(stream, model, super_batch=group_k)
@@ -155,8 +158,9 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         ckpt.final_save(totals)
     if ssc.failed:
         raise RuntimeError(
-            "multi-host lockstep run aborted (see critical log above); "
-            "progress up to the failure is checkpointed"
+            "run aborted by a runtime guard — lockstep peer loss or a fetch "
+            "watchdog abort (see critical log above); progress up to the "
+            "failure is checkpointed"
         )
     return totals
 

@@ -35,6 +35,7 @@ from .common import (
     build_model,
     build_source,
     init_distributed,
+    install_chaos,
     install_trace,
     select_backend,
     warmup_compile,
@@ -59,6 +60,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
 
     lockstep = jax.process_count() > 1
     install_trace(conf)
+    install_chaos(conf)
 
     ssc = StreamingContext(batch_interval=conf.seconds)
     stream = ssc.source_stream(
@@ -119,6 +121,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         max_dispatch=(
             max(1, max_batches - totals["batches"]) if max_batches else 0
         ),
+        abort=ssc.request_abort,  # fetch-watchdog aborts fail the run loudly
     )
     warmup_compile(stream, model, super_batch=group_k)
     ssc.start(lockstep=lockstep)
@@ -137,8 +140,9 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         ckpt.final_save(totals)
     if ssc.failed:
         raise RuntimeError(
-            "multi-host lockstep run aborted (see critical log above); "
-            "progress up to the failure is checkpointed"
+            "run aborted by a runtime guard — lockstep peer loss or a fetch "
+            "watchdog abort (see critical log above); progress up to the "
+            "failure is checkpointed"
         )
     return totals
 

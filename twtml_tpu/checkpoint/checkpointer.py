@@ -32,6 +32,25 @@ class Checkpointer:
         self.directory = directory
         self.keep_last = keep_last
         os.makedirs(directory, exist_ok=True)
+        self._sweep_stale_tmp()
+
+    def _sweep_stale_tmp(self) -> None:
+        """A hard kill between mkstemp and the atomic rename leaks the
+        ``*.tmp`` forever — ``_prune`` only matches finished
+        ``ckpt-*.npz`` names, so sweep them at startup. Safe: once this
+        process runs, it is the directory's only writer (multi-host
+        writes are lead-only, apps/common.AppCheckpoint)."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in names:
+            if name.endswith(".tmp"):
+                try:
+                    os.unlink(os.path.join(self.directory, name))
+                    log.info("swept stale checkpoint temp file %s", name)
+                except OSError:
+                    pass
 
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"ckpt-{step:012d}.npz")

@@ -139,6 +139,8 @@ class ConfArguments:
         self.profileDir: str = conf.get("profileDir", "")
         self.trace: str = conf.get("trace", "")
         self.faultEvery: int = int(conf.get("faultEvery", "0"))
+        self.chaos: str = conf.get("chaos", "")
+        self.webTimeout: float = float(conf.get("webTimeout", "2.0"))
         self.superBatch: int = int(conf.get("superBatch", "1"))
         self.recycleAfterMb: int = int(conf.get("recycleAfterMb", "0"))
 
@@ -241,6 +243,18 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                                                stats) with wire bytes + health-phase stamps;
                                                summarize with tools/trace_report.py
   --faultEvery <int tweets>                    Inject a receiver crash every N tweets (chaos testing)
+  --chaos <spec>                               Transport chaos injection BELOW the source layer
+                                               (testing the runtime guards): comma-separated
+                                               TARGET:ACTION[@TRIGGER] clauses over targets
+                                               fetch|step|web. ACTION: delay=SECONDS (stall= is
+                                               an alias) or error. TRIGGER: N (every Nth call),
+                                               pP (probability P), fromN (every call from the
+                                               Nth on); plus seed=N. Example:
+                                               "fetch:delay=2@3,web:error@p0.5,seed=7"
+  --webTimeout <float seconds>                 Dashboard/web-API request timeout (per publish;
+                                               the publish circuit breaker stops a dead
+                                               dashboard from costing this per batch).
+                                               Default: {self.webTimeout}
   --recycleAfterMb <int MB>                    Bounded process lifetime: checkpoint at the next
                                                batch boundary and re-exec in place once process
                                                RSS crosses this ceiling (needs --checkpointDir;
@@ -344,6 +358,10 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
             self.recycleAfterMb = int(take())
         elif flag == "--faultEvery":
             self.faultEvery = int(take())
+        elif flag == "--chaos":
+            self.chaos = take()
+        elif flag == "--webTimeout":
+            self.webTimeout = float(take())
         elif flag in ("--help", "-h"):
             self.printUsage(0)
         else:

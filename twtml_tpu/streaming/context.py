@@ -38,6 +38,49 @@ log = get_logger("streaming.context")
 
 BatchFn = Callable[[FeatureBatch, float], None]
 
+# lockstep peer watchdog: how long the per-tick cadence allgather may make
+# no progress before this host concludes a peer is gone (hard kill /
+# network partition) and aborts loudly instead of hanging in the
+# collective forever. Generous default: ticks legitimately skew by a slow
+# host's featurize/parse + a ~30s first-batch compile. 0 disables.
+LOCKSTEP_TIMEOUT_ENV = "TWTML_LOCKSTEP_TIMEOUT_S"
+LOCKSTEP_TIMEOUT_DEFAULT_S = 120.0
+
+
+def _watched_allgather(arr, timeout_s: float):
+    """Run one cadence allgather under a progress watchdog: returns the
+    gathered array, or None when the watchdog fired. The collective runs
+    on a daemon thread (never a ThreadPoolExecutor — concurrent.futures
+    joins its workers at interpreter exit, so a wedged collective would
+    hang shutdown; a daemon thread dies with the process). The scheduler
+    blocks on the result before dispatching, so per-host collective issue
+    order stays total — only the executing thread changes. Thread spawn is
+    ~50µs against a per-batch tick; exceptions from the collective (a dead
+    peer often surfaces as a transport error rather than a hang) propagate
+    to the caller."""
+    from jax.experimental import multihost_utils
+
+    if timeout_s <= 0:
+        return multihost_utils.process_allgather(arr)
+    box: dict = {}
+    done = threading.Event()
+
+    def run() -> None:
+        try:
+            box["out"] = multihost_utils.process_allgather(arr)
+        except BaseException as exc:  # noqa: BLE001 — re-raised below
+            box["exc"] = exc
+        done.set()
+
+    threading.Thread(
+        target=run, daemon=True, name="twtml-lockstep-allgather"
+    ).start()
+    if not done.wait(timeout_s):
+        return None
+    if "exc" in box:
+        raise box["exc"]
+    return box["out"]
+
 
 class _RowCountQueue(queue.Queue):
     """queue.Queue that also tracks the queued ROW count (a ParsedBlock item
@@ -374,6 +417,14 @@ class StreamingContext:
         early-exit hook apps use for max-batches caps."""
         self._stop.set()
 
+    def request_abort(self) -> None:
+        """Loud-failure hook for the runtime guards (fetch watchdog,
+        lockstep peer watchdog): mark the run failed and stop after the
+        current batch, so the app's shutdown path still flushes its final
+        checkpoint and the process exits non-zero."""
+        self.failed = True
+        self.request_stop()
+
     @property
     def stop_requested(self) -> bool:
         """Whether a stop has been requested (read by the concurrent
@@ -459,13 +510,29 @@ class StreamingContext:
         and the run is marked ``failed`` so the app can exit non-zero
         rather than report success.
 
+        A hard-killed peer can never tick its abort flag, so the allgather
+        itself runs under a progress watchdog (``_watched_allgather``,
+        ``TWTML_LOCKSTEP_TIMEOUT_S``): when it fires — or the collective
+        raises a transport error, the other way a dead peer surfaces —
+        this host aborts LOUDLY (``failed=True`` → the app exits non-zero
+        after its shutdown path flushes a final checkpoint) instead of
+        hanging in the collective forever. Collectives INSIDE a dispatched
+        step are covered separately: their results surface through the
+        pooled stats fetch, whose own watchdog (apps/common.FetchWatchdog)
+        aborts the same way.
+
         Drains are capped at the row bucket in BOTH modes (wall-clock rows
         beyond the bucket stay queued for the next tick): an uncapped drain
         could exceed --batchBucket and grow this host's program shape away
         from its peers'."""
-        import numpy as np
-        from jax.experimental import multihost_utils
+        import os
 
+        import numpy as np
+
+        watch_s = float(
+            os.environ.get(LOCKSTEP_TIMEOUT_ENV, "")
+            or LOCKSTEP_TIMEOUT_DEFAULT_S
+        )
         limit = getattr(self._stream, "row_bucket", 0)
         next_tick = time.monotonic() + self.batch_interval
         aborting = False
@@ -486,13 +553,42 @@ class StreamingContext:
             local = self._drain(limit)
             rows = sum(getattr(s, "rows", 1) for s in local)
             more = (not self._source.exhausted) or self._queue.rows_queued > 0
-            flags = multihost_utils.process_allgather(
-                np.array(
-                    [rows > 0 and not aborting, more and not aborting,
-                     aborting],
-                    dtype=np.int32,
+            try:
+                flags = _watched_allgather(
+                    np.array(
+                        [rows > 0 and not aborting, more and not aborting,
+                         aborting],
+                        dtype=np.int32,
+                    ),
+                    watch_s,
                 )
-            )
+            except Exception:
+                log.critical(
+                    "lockstep cadence allgather FAILED — a peer likely "
+                    "died mid-run; aborting this host loudly (progress up "
+                    "to the last checkpoint boundary is saved)",
+                    exc_info=True,
+                )
+                _metrics.get_registry().counter(
+                    "lockstep.watchdog_aborts"
+                ).inc()
+                self.failed = True
+                break
+            if flags is None:
+                log.critical(
+                    "lockstep peer watchdog: the cadence allgather made no "
+                    "progress in %.0fs — a peer is gone (hard kill or "
+                    "network partition). Aborting this host loudly instead "
+                    "of hanging in the collective; tune with %s (0 "
+                    "disables).",
+                    watch_s, LOCKSTEP_TIMEOUT_ENV,
+                )
+                _metrics.get_registry().counter(
+                    "lockstep.watchdog_aborts"
+                ).inc()
+                _trace.get().instant("lockstep_watchdog", timeout_s=watch_s)
+                self.failed = True
+                break
             if flags[:, 2].any():
                 # this host (or a peer) aborted: everyone has now agreed on
                 # it in the same tick, so everyone can stop dispatching

@@ -1,5 +1,6 @@
-"""Fault injection for stream sources (SURVEY.md §5.3: the reference has no
-fault injection anywhere; receiver recovery was whatever Spark defaulted to).
+"""Fault injection for stream sources AND the transport below them
+(SURVEY.md §5.3: the reference has no fault injection anywhere; receiver
+recovery was whatever Spark defaulted to).
 
 ``FaultInjectingSource`` wraps any Source and raises a simulated receiver
 crash every ``crash_every`` tweets (deterministic) or with probability
@@ -7,11 +8,40 @@ crash every ``crash_every`` tweets (deterministic) or with probability
 harness end-to-end in tests and chaos runs. Emitted tweets are passed through
 unchanged; a crash loses the in-flight iterator exactly like a dropped
 socket, so delivery gaps behave like the real failure mode.
+
+``ChaosInjector`` (``--chaos SPEC``) extends the same idea BELOW the source
+layer, to the external dependencies the tunnel facts make the real failure
+domain (BENCHMARKS.md "Measurement integrity": stalls burst for minutes,
+RTT 50–90 ms): seeded latency spikes / multi-second stalls / exceptions at
+three injection points —
+
+- ``fetch``  — the pooled ``device_get``s (FetchPipeline / SuperBatcher),
+- ``step``   — the device dispatch (``model.step``/``step_many``),
+- ``web``    — every dashboard HTTP request (``WebClient._request``),
+
+so the runtime guards those points carry (fetch deadline/retry/abort, the
+publish circuit breaker, the lockstep watchdogs) are testable end-to-end.
+
+Spec grammar (comma-separated clauses):
+
+    TARGET:ACTION[@TRIGGER]   or   seed=N
+
+    ACTION   delay=SECONDS (sleep before the call — a spike or a stall,
+             depending on magnitude; ``stall=`` is an alias) | error
+             (raise InjectedFault instead of the call)
+    TRIGGER  N       every Nth call of that target (deterministic)
+             pP      probability P per call (seeded RNG)
+             fromN   every call from the Nth on (a permanent outage)
+             default: every call
+
+Example: ``--chaos "fetch:delay=2@3,web:error@p0.5,step:stall=5@from40,seed=7"``
 """
 
 from __future__ import annotations
 
 import random
+import threading
+import time
 from typing import Iterator
 
 from ..utils import get_logger
@@ -19,9 +49,172 @@ from .sources import Source
 
 log = get_logger("streaming.faults")
 
+CHAOS_TARGETS = ("fetch", "step", "web")
+
 
 class InjectedFault(ConnectionError):
     pass
+
+
+class _ChaosRule:
+    """One parsed ``TARGET:ACTION[@TRIGGER]`` clause."""
+
+    __slots__ = ("target", "kind", "value", "mode", "param")
+
+    def __init__(self, target: str, kind: str, value: float, mode: str,
+                 param: float):
+        self.target = target
+        self.kind = kind  # "delay" | "error"
+        self.value = value  # sleep seconds (delay only)
+        self.mode = mode  # "every" | "prob" | "from"
+        self.param = param
+
+    def fires(self, call_index: int, rng: random.Random) -> bool:
+        if self.mode == "every":
+            return call_index % int(self.param) == 0
+        if self.mode == "from":
+            return call_index >= int(self.param)
+        return rng.random() < self.param
+
+    def __repr__(self) -> str:  # shows up in the install log line
+        act = "error" if self.kind == "error" else f"delay={self.value:g}s"
+        trig = {"every": "every %d", "from": "from call %d on",
+                "prob": "p=%g"}[self.mode] % self.param
+        return f"{self.target}:{act} ({trig})"
+
+
+def _parse_trigger(text: str) -> "tuple[str, float]":
+    if text.startswith("p"):
+        p = float(text[1:])
+        if not 0.0 < p <= 1.0:
+            raise ValueError(f"probability trigger out of (0, 1]: {text!r}")
+        return "prob", p
+    if text.startswith("from"):
+        n = int(text[len("from"):])
+        if n < 1:
+            raise ValueError(f"'from' trigger must be >= 1: {text!r}")
+        return "from", n
+    n = int(text)
+    if n < 1:
+        raise ValueError(f"every-Nth trigger must be >= 1: {text!r}")
+    return "every", n
+
+
+class ChaosInjector:
+    """Seeded transport-fault injector. ``perturb(target)`` is called at
+    each injection point: it may sleep (latency spike / stall) and/or raise
+    ``InjectedFault`` according to the parsed rules. Thread-safe — the
+    pooled fetch calls it from worker threads; sleeps happen outside the
+    lock so concurrent fetches stall independently, like real tunnel
+    stalls. Deterministic for a given seed and per-target call sequence."""
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        seed = 0
+        rules: list[_ChaosRule] = []
+        for raw in spec.split(","):
+            clause = raw.strip()
+            if not clause:
+                continue
+            if clause.startswith("seed="):
+                seed = int(clause[len("seed="):])
+                continue
+            target, sep, action = clause.partition(":")
+            if not sep or target not in CHAOS_TARGETS:
+                raise ValueError(
+                    f"bad chaos clause {clause!r}: want TARGET:ACTION with "
+                    f"TARGET in {CHAOS_TARGETS}"
+                )
+            action, _, trigger = action.partition("@")
+            mode, param = _parse_trigger(trigger) if trigger else ("every", 1)
+            if action == "error":
+                rules.append(_ChaosRule(target, "error", 0.0, mode, param))
+            elif action.startswith(("delay=", "stall=")):
+                value = float(action.partition("=")[2])
+                if value <= 0:
+                    raise ValueError(f"non-positive delay in {clause!r}")
+                rules.append(_ChaosRule(target, "delay", value, mode, param))
+            else:
+                raise ValueError(
+                    f"bad chaos action {action!r} in {clause!r}: want "
+                    "delay=SECONDS, stall=SECONDS, or error"
+                )
+        if not rules:
+            raise ValueError(f"chaos spec {spec!r} names no injection rules")
+        self._rules: dict[str, list[_ChaosRule]] = {}
+        for r in rules:
+            self._rules.setdefault(r.target, []).append(r)
+        self._rng = random.Random(seed)
+        self._calls = {t: 0 for t in CHAOS_TARGETS}
+        self._lock = threading.Lock()
+
+    def perturb(self, target: str) -> None:
+        """Apply this call's injections for ``target``: sleep for every
+        firing delay rule, then raise if any error rule fired."""
+        rules = self._rules.get(target)
+        if not rules:
+            return
+        with self._lock:
+            self._calls[target] += 1
+            n = self._calls[target]
+            fired = [r for r in rules if r.fires(n, self._rng)]
+        if not fired:
+            return
+        from ..telemetry import metrics as _metrics
+
+        reg = _metrics.get_registry()
+        raise_after = False
+        for r in fired:
+            reg.counter("chaos.injected").inc()
+            if r.kind == "delay":
+                reg.counter(f"chaos.{target}.delays").inc()
+                log.warning(
+                    "chaos: injecting %.2fs %s into %s call #%d",
+                    r.value, "stall" if r.value >= 1 else "delay", target, n,
+                )
+                time.sleep(r.value)
+            else:
+                reg.counter(f"chaos.{target}.errors").inc()
+                raise_after = True
+        if raise_after:
+            raise InjectedFault(f"injected {target} fault (call #{n})")
+
+    def calls(self, target: str) -> int:
+        return self._calls.get(target, 0)
+
+
+# process-wide injector: injection points are scattered across layers
+# (apps/common fetch+dispatch, telemetry/web_client) and all belong to the
+# one run-level chaos configuration the --chaos flag names
+_CHAOS: "ChaosInjector | None" = None
+
+
+def install_chaos(spec: str) -> ChaosInjector:
+    """Parse + activate a chaos spec process-wide (``--chaos`` wiring;
+    raises ValueError on a malformed spec)."""
+    global _CHAOS
+    _CHAOS = ChaosInjector(spec)
+    log.warning(
+        "transport chaos ACTIVE: %s",
+        "; ".join(repr(r) for rs in _CHAOS._rules.values() for r in rs),
+    )
+    return _CHAOS
+
+
+def uninstall_chaos() -> None:
+    global _CHAOS
+    _CHAOS = None
+
+
+def get_chaos() -> "ChaosInjector | None":
+    return _CHAOS
+
+
+def perturb(target: str) -> None:
+    """Module-level injection point: no-op unless a chaos spec is
+    installed (one global read on the hot path)."""
+    if _CHAOS is not None:
+        _CHAOS.perturb(target)
 
 
 class FaultInjectingSource(Source):

@@ -102,10 +102,26 @@ class Source:
         del exc
         return min(self.restart_backoff * (2 ** min(restarts - 1, 12)), 30.0)
 
+    # how long stop() waits for the producer thread; class-level so tests
+    # can shrink it without monkeypatching join()
+    JOIN_TIMEOUT_S = 5.0
+
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=self.JOIN_TIMEOUT_S)
+            if thread.is_alive():
+                # a silent timed-out join here used to make stuck shutdowns
+                # invisible — name the wedged thread so the operator can
+                # see WHICH producer is blocked (daemon threads die with
+                # the process, so shutdown still completes)
+                log.warning(
+                    "source %s did not stop: producer thread %r still "
+                    "running %.1fs after the stop request (wedged in a "
+                    "blocking call?); proceeding with shutdown",
+                    self.name, thread.name, self.JOIN_TIMEOUT_S,
+                )
 
     @property
     def exhausted(self) -> bool:

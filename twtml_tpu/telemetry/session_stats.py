@@ -5,6 +5,15 @@ lighter "detail" shades, SessionStats.scala:15-20,49-52), registers the
 session with the twtml web server (``web.config``), and pushes per-batch
 stats to both. Every network call is best-effort (``Try`` in the reference,
 SessionStats.scala:29-33,60): the ML loop must survive telemetry outages.
+
+Best-effort hardened (r7): each endpoint sits behind a circuit breaker
+(telemetry/breaker.py) — a dead dashboard stops costing the hot path its
+full ``--webTimeout`` per publish after ``FAILURE_THRESHOLD`` consecutive
+failures (drop-and-count, half-open probe re-admits it) — and when the
+tunnel-health monitor reports a DEGRADED transport, the per-batch series
+frames (the biggest payload) shed to every ``SERIES_SHED_EVERY``-th batch
+while the scalar stats keep full resolution. Neither mechanism changes the
+reference parity: publishes still never raise into the ML loop.
 """
 
 from __future__ import annotations
@@ -14,6 +23,7 @@ import numpy as np
 from ..utils import get_logger, round_half_up
 from . import metrics as _metrics
 from . import trace as _trace
+from .breaker import CircuitBreaker
 from .lightning import CHART_MAX_POINTS, Lightning, Visualization
 from .web_client import WebClient
 
@@ -28,6 +38,10 @@ SERIES_MAX_POINTS = CHART_MAX_POINTS
 # and each publish is one more best-effort HTTP POST on the hot path
 METRICS_EVERY = 8
 
+# degraded-tunnel load shedding: ship only every Nth batch's series frame
+# while the health monitor reports a degraded transport
+SERIES_SHED_EVERY = 8
+
 # SessionStats.scala:15-20
 REAL_COLOR_DET = [173.0, 216.0, 230.0]  # light blue
 REAL_COLOR = [30.0, 144.0, 255.0]  # blue
@@ -39,9 +53,17 @@ class SessionStats:
     def __init__(self, conf):
         self.conf = conf
         self.lgn = Lightning(host=conf.lightning)
-        self.web = WebClient(conf.twtweb)
+        self.web = WebClient(
+            conf.twtweb, timeout=float(getattr(conf, "webTimeout", 2.0))
+        )
         self.viz: Visualization | None = None
         self._updates = 0
+        # one breaker per endpoint: the web dashboard and Lightning fail
+        # independently (PARITY: the reference's Try semantics are
+        # preserved — the breaker only decides whether the best-effort
+        # attempt is MADE, never raises into the ML loop)
+        self._web_breaker = CircuitBreaker("web")
+        self._lgn_breaker = CircuitBreaker("lightning")
 
     def open(self) -> "SessionStats":
         log.info("Initializing plot on lightning server: %s", self.conf.lightning)
@@ -90,16 +112,34 @@ class SessionStats:
         with tr.span("stats_publish", batch=int(batch)):
             self._update(count, batch, mse, real_stdev, pred_stdev, real, pred)
 
+    def _series_due(self) -> bool:
+        """Degraded-tunnel load shedding: the per-batch series frame is the
+        biggest publish payload; while the health monitor reports a
+        DEGRADED transport, ship only every ``SERIES_SHED_EVERY``-th one
+        (the scalar stats above keep full per-batch resolution)."""
+        monitor = _metrics.get_health_monitor()
+        if monitor.phase != monitor.DEGRADED:
+            return True
+        if self._updates % SERIES_SHED_EVERY == 0:
+            return True
+        _metrics.get_registry().counter("publish.series_shed").inc()
+        return False
+
     def _update(
         self, count, batch, mse, real_stdev, pred_stdev, real, pred
     ) -> None:
-        stats_ok = True
-        try:
-            self.web.stats(count, batch, int(mse), int(real_stdev), int(pred_stdev))
-        except Exception:
-            stats_ok = False
-            log.debug("web.stats failed", exc_info=True)
-        if stats_ok:
+        stats_ok = False
+        if self._web_breaker.allow():
+            try:
+                self.web.stats(
+                    count, batch, int(mse), int(real_stdev), int(pred_stdev)
+                )
+                self._web_breaker.record_success()
+                stats_ok = True
+            except Exception:
+                self._web_breaker.record_failure()
+                log.debug("web.stats failed", exc_info=True)
+        if stats_ok and self._series_due():
             # feed the built-in dashboard chart (Lightning-free path); the
             # chart window keeps ~400 points, so huge bench-scale batches are
             # subsampled before paying the JSON encode on the hot path
@@ -109,9 +149,11 @@ class SessionStats:
                     list(pred[:SERIES_MAX_POINTS]),
                     real_stdev, pred_stdev,
                 )
+                self._web_breaker.record_success()
             except Exception:
+                self._web_breaker.record_failure()
                 log.debug("web.series failed", exc_info=True)
-        if self.viz is not None:
+        if self.viz is not None and self._lgn_breaker.allow():
             try:
                 real_stdev_arr = [real_stdev] * int(batch)
                 pred_stdev_arr = [pred_stdev] * int(batch)
@@ -119,7 +161,9 @@ class SessionStats:
                     series=[list(real), list(pred), real_stdev_arr, pred_stdev_arr],
                     viz=self.viz,
                 )
+                self._lgn_breaker.record_success()
             except Exception:
+                self._lgn_breaker.record_failure()
                 log.debug("lightning append failed", exc_info=True)
         self._updates += 1
         if self._updates % METRICS_EVERY == 0:
@@ -128,11 +172,15 @@ class SessionStats:
     def publish_metrics(self) -> None:
         """Best-effort push of the process metrics registry + tunnel-health
         summary to the dashboard's observability panel (/api/metrics)."""
+        if not self._web_breaker.allow():
+            return
         try:
             snap = _metrics.get_registry().snapshot()
             self.web.metrics(
                 snap["counters"], snap["gauges"],
                 _metrics.get_health_monitor().summary(),
             )
+            self._web_breaker.record_success()
         except Exception:
+            self._web_breaker.record_failure()
             log.debug("web.metrics failed", exc_info=True)

@@ -22,6 +22,13 @@ class WebClient:
         self.timeout = timeout
 
     def _request(self, kind: str = "", data: bytes | None = None):
+        # --chaos web injection point (streaming/faults.py): a dead or
+        # slow dashboard, simulated before the socket. Lazy import — a
+        # module-level one would cycle through streaming/__init__ while
+        # telemetry/__init__ is still importing this module.
+        from ..streaming import faults as _faults
+
+        _faults.perturb("web")
         req = urllib.request.Request(
             self.server + "/api" + kind,
             data=data,
