@@ -194,3 +194,77 @@ def test_shard_batch_reuses_prealigned():
     sb = shard_batch(ab, mesh)
     assert sb.num_shards == 4
     np.testing.assert_array_equal(np.asarray(sb.units), np.asarray(ab.units))
+
+
+# -- degenerate shard segments (ISSUE 3 satellite) ---------------------------
+# The lockstep all-padding-batch contract (streaming/context._lockstep_loop:
+# dry shards dispatch all-padding batches every tick) means the sharded
+# one-buffer wire MUST round-trip shards that hold no rows at all, and
+# shards that hold exactly one tweet — the boundary cases of the
+# segment-relative offset layout (and of its uint16-delta encoding).
+
+
+def _sparse_ragged(n_real, rows=32, seed=21):
+    """A ragged batch whose last shards are pure padding: only the first
+    ``n_real`` rows are real (featurizer pads the rest)."""
+    feat = Featurizer(now_ms=1785320000000)
+    return feat.featurize_batch_ragged(
+        synthetic(n=n_real, seed=seed), row_bucket=rows, unit_bucket=64,
+        pre_filtered=True,
+    )
+
+
+@pytest.mark.parametrize("n_real", [3, 1, 32])
+def test_degenerate_shards_roundtrip_one_buffer_wire(n_real):
+    """All-padding shards (n_real=3 → shards 1-3 empty; n_real=1 → a
+    single-tweet shard plus three empty ones) must round-trip the sharded
+    one-buffer wire bit-identically — packed sharded AND coalesced group,
+    narrow and int32 offsets."""
+    from twtml_tpu.features.batch import (
+        pack_ragged_group,
+        pack_ragged_sharded,
+        unpack_batch,
+    )
+
+    rb = _sparse_ragged(n_real)
+    aligned = align_ragged_shards(rb, 4)
+    assert rb.num_valid == n_real
+    for narrow in (None, False):
+        pk = pack_ragged_sharded(aligned, narrow_offsets=narrow)
+        back = unpack_batch(pk.buffer, pk.layout)
+        for f in ("units", "offsets", "numeric", "label", "mask"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(back, f)), np.asarray(getattr(aligned, f))
+            )
+        assert back.num_shards == 4
+        pg = pack_ragged_group([aligned], narrow_offsets=narrow)
+        gback = unpack_batch(pg.buffer, pg.layout)
+        for f in ("units", "offsets", "numeric", "label", "mask"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(gback, f))[0],
+                np.asarray(getattr(aligned, f)),
+            )
+
+
+@pytest.mark.parametrize("n_real", [3, 1])
+def test_degenerate_shards_train_identically_on_mesh(n_real):
+    """The mesh step over the one-buffer wire with empty/single-tweet
+    shards equals the flat single-device ragged step — the app-level form
+    of the lockstep all-padding contract."""
+    rb = _sparse_ragged(n_real)
+    ref = StreamingLinearRegressionWithSGD(num_iterations=5, step_size=0.05)
+    out_ref = ref.step(rb)
+
+    mesh = make_mesh(num_data=4, devices=jax.devices()[:4])
+    m = ParallelSGDModel(mesh, num_iterations=5, step_size=0.05)
+    out_pk = m.step(m.pack_for_wire(rb))
+    assert float(out_pk.count) == float(out_ref.count) == n_real
+    np.testing.assert_array_equal(
+        np.asarray(out_pk.predictions), np.asarray(out_ref.predictions)
+    )
+    np.testing.assert_array_equal(m.latest_weights, ref.latest_weights)
+
+    g = ParallelSGDModel(mesh, num_iterations=5, step_size=0.05)
+    many = g.step_many(g.pack_group_for_wire([rb]))
+    assert float(many.count[0]) == n_real
+    np.testing.assert_array_equal(g.latest_weights, ref.latest_weights)

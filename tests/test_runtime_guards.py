@@ -367,3 +367,88 @@ def test_web_timeout_flag_threads_through():
     conf = ConfArguments().parse(["--webTimeout", "0.25"])
     assert conf.webTimeout == 0.25
     assert SessionStats(conf).web.timeout == 0.25
+
+
+# -- abort refunds (ISSUE 3 satellite): every dispatched batch is either
+# delivered to the handler or refunded — partial singles and coalesced/
+# grouped dispatches alike, so cap accounting stays honest across aborts --
+
+
+def test_superbatcher_partial_abort_refunds_dispatch():
+    """The partial path's batch trains before its synchronous fetch; when
+    that fetch aborts, the dispatch slot is refunded (trained-but-
+    undelivered must not consume max_dispatch budget)."""
+    model = FlakyFetchModel(slow={0: {n: 0.5 for n in range(1, 10)}})
+    sb = SuperBatcher(
+        model, 4, lambda out, b, t, at_boundary: None,
+        fetch_deadline_s=0.05, fetch_retries=1, max_dispatch=8,
+    )
+    sb.on_batch(np.asarray(0), 0.0)
+    with pytest.raises(FetchAbort):
+        sb._close_group()
+    assert sb._dispatched == 0  # the slot came back
+    assert _metrics.get_registry().counter("fetch.refunds").snapshot() == 1
+    sb.flush()  # clean no-op after the abort
+
+
+def test_superbatcher_flush_refunds_undelivered_groups():
+    """Grouped dispatches (the coalesced-wire path included) that are
+    in flight when the tunnel wedges: flush drops them AND refunds every
+    batch they carried."""
+    import time as _time
+
+    import jax
+
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.models import StreamingLinearRegressionWithSGD
+    from twtml_tpu.streaming.sources import SyntheticSource
+
+    class WedgedGroupFetch:
+        """Real learner, wedged pooled fetches — groups dispatch fine and
+        every fetch stalls past the watchdog deadline."""
+
+        accepts_packed = True
+
+        def __init__(self):
+            self.inner = StreamingLinearRegressionWithSGD(num_iterations=2)
+
+        def step(self, b):
+            return self.inner.step(b)
+
+        def step_many(self, stacked):
+            return self.inner.step_many(stacked)
+
+        def fetch_output(self, out):
+            _time.sleep(0.5)
+            return jax.device_get(out)
+
+        fetch_output_many = fetch_output
+
+    statuses = list(
+        SyntheticSource(total=64, seed=3, base_ms=1785320000000).produce()
+    )
+    feat = Featurizer(now_ms=1785320000000)
+    batches = [
+        feat.featurize_batch_ragged(
+            statuses[i * 16 : (i + 1) * 16], row_bucket=16, unit_bucket=512,
+            pre_filtered=True,
+        )
+        for i in range(4)
+    ]
+    for wire_pack in ("group", "stacked"):
+        _metrics.reset_for_tests()
+        aborted = []
+        sb = SuperBatcher(
+            WedgedGroupFetch(), 2, lambda out, b, t, at_boundary: None,
+            fetch_depth=4, fetch_deadline_s=0.05, fetch_retries=1,
+            abort=lambda: aborted.append(True), wire_pack=wire_pack,
+        )
+        for i, b in enumerate(batches):
+            sb.on_batch(b, float(i))
+        assert sb._dispatched == 4  # two groups of two, both in flight
+        sb.flush()  # abort inside the drain is swallowed; refunds land
+        assert aborted == [True]
+        assert sb._dispatched == 0, wire_pack
+        assert (
+            _metrics.get_registry().counter("fetch.refunds").snapshot() == 4
+        ), wire_pack

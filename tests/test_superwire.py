@@ -1,0 +1,350 @@
+"""Lean wire v2 (ISSUE 3): the coalesced one-buffer superbatch wire
+(``pack_ragged_group``) and the narrow uint16-delta offset wire must be
+BYTE-IDENTICAL in features, per-batch stats, and final weights to the
+shipped packed-ragged path — single-device AND sharded layouts, K ∈
+{1, 4, 8} — with the int32 offset fallback metadata-gated exactly like the
+uint8/uint16 units switch (rows longer than the uint16 delta range trip
+it). The wire may change transfer count and sideband bytes, never math."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from twtml_tpu.features.batch import (
+    OFFSET_DELTA_MAX,
+    RaggedUnitBatch,
+    offsets_narrow,
+    pack_batch,
+    pack_ragged_group,
+    pack_ragged_sharded,
+    ragged_wire_arrays,
+    stack_batches,
+    unpack_batch,
+    wire_composition,
+    wire_nbytes,
+)
+from twtml_tpu.features.featurizer import Featurizer
+from twtml_tpu.models import StreamingLinearRegressionWithSGD
+from twtml_tpu.streaming.sources import SyntheticSource
+
+
+def ragged_batches(n=4, rows=16, unit_bucket=512):
+    """n same-signature ragged batches (one compiled program's worth —
+    the SuperBatcher grouping precondition)."""
+    statuses = list(
+        SyntheticSource(total=n * rows, seed=3, base_ms=1785320000000).produce()
+    )
+    feat = Featurizer(now_ms=1785320000000)
+    return [
+        feat.featurize_batch_ragged(
+            statuses[i * rows : (i + 1) * rows], row_bucket=rows,
+            unit_bucket=unit_bucket, pre_filtered=True,
+        )
+        for i in range(n)
+    ]
+
+
+def wide_ragged_batch(rows=8, row_len=32, seed=5):
+    """Hand-built NON-ASCII (uint16 units) ragged batch — the wide-units
+    wire composed with the narrow-offsets wire."""
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(2, row_len, size=rows)
+    offsets = np.zeros(rows + 1, np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    units = rng.integers(0x100, 0x3FF, size=int(lens.sum())).astype(np.uint16)
+    flat, offs = ragged_wire_arrays(units, offsets, rows, rows, narrow=False)
+    return RaggedUnitBatch(
+        flat, offs,
+        rng.normal(size=(rows, 4)).astype(np.float32),
+        rng.uniform(0, 100, size=(rows,)).astype(np.float32),
+        np.ones((rows,), np.float32),
+        row_len=row_len,
+    )
+
+
+def long_row_batch(rows=4, long_len=OFFSET_DELTA_MAX + 2):
+    """One row longer than the uint16 delta range: the static row_len
+    bucket exceeds 65,535, so the metadata gate keeps the int32 offsets."""
+    from twtml_tpu.features.batch import _bucket
+
+    rng = np.random.default_rng(7)
+    lens = np.array([8, long_len, 4, 6][:rows])
+    offsets = np.zeros(rows + 1, np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    units = rng.integers(97, 123, size=int(lens.sum())).astype(np.uint8)
+    flat, offs = ragged_wire_arrays(units, offsets, rows, rows, narrow=True)
+    return RaggedUnitBatch(
+        flat, offs,
+        rng.normal(size=(rows, 4)).astype(np.float32),
+        rng.uniform(0, 100, size=(rows,)).astype(np.float32),
+        np.ones((rows,), np.float32),
+        row_len=_bucket(long_len),
+    )
+
+
+# -- coalesced group wire: differential vs the shipped paths -----------------
+
+@pytest.mark.parametrize("k", [1, 4, 8])
+def test_group_wire_matches_sequential_single_device(k):
+    batches = ragged_batches(n=k)
+    seq = StreamingLinearRegressionWithSGD(num_iterations=5)
+    outs = [seq.step(pack_batch(b)) for b in batches]  # the shipped k=1 wire
+
+    sup = StreamingLinearRegressionWithSGD(num_iterations=5)
+    many = sup.step_many(pack_ragged_group(batches))
+    np.testing.assert_array_equal(sup.latest_weights, seq.latest_weights)
+    for i, out in enumerate(outs):
+        assert float(many.mse[i]) == float(out.mse)
+        assert float(many.count[i]) == float(out.count)
+        np.testing.assert_array_equal(
+            np.asarray(many.predictions[i]), np.asarray(out.predictions)
+        )
+
+    # and vs the stacked superbatch wire (the pre-v2 grouping layout)
+    stk = StreamingLinearRegressionWithSGD(num_iterations=5)
+    stk.step_many(stack_batches(batches))
+    np.testing.assert_array_equal(stk.latest_weights, sup.latest_weights)
+
+
+@pytest.mark.parametrize("k", [1, 4, 8])
+def test_group_wire_matches_sequential_mesh(k):
+    from twtml_tpu.parallel import ParallelSGDModel, make_mesh
+    from twtml_tpu.parallel.sharding import shard_batch
+
+    batches = ragged_batches(n=k, rows=32)
+    mesh = make_mesh(num_data=4, devices=jax.devices()[:4])
+    seq = ParallelSGDModel(mesh, num_iterations=5, step_size=0.05)
+    outs = [seq.step(shard_batch(b, mesh)) for b in batches]
+
+    sup = ParallelSGDModel(mesh, num_iterations=5, step_size=0.05)
+    many = sup.step_many(sup.pack_group_for_wire(batches))
+    np.testing.assert_array_equal(sup.latest_weights, seq.latest_weights)
+    for i, out in enumerate(outs):
+        assert float(many.mse[i]) == float(out.mse)
+        np.testing.assert_array_equal(
+            np.asarray(many.predictions[i]), np.asarray(out.predictions)
+        )
+
+
+def test_group_wire_2d_mesh_matches_sequential():
+    from twtml_tpu.parallel import ParallelSGDModel, make_mesh
+    from twtml_tpu.parallel.sharding import shard_batch
+
+    batches = ragged_batches(n=4, rows=32)
+    mesh = make_mesh(num_data=2, num_model=2, devices=jax.devices()[:4])
+    seq = ParallelSGDModel(mesh, num_iterations=5, step_size=0.05)
+    outs = [seq.step(shard_batch(b, mesh)) for b in batches]
+    sup = ParallelSGDModel(mesh, num_iterations=5, step_size=0.05)
+    many = sup.step_many(sup.pack_group_for_wire(batches))
+    np.testing.assert_array_equal(sup.latest_weights, seq.latest_weights)
+    for i, out in enumerate(outs):
+        assert float(many.mse[i]) == float(out.mse)
+
+
+def test_group_wire_wide_units():
+    """Non-ASCII (uint16) units compose with the group wire and the narrow
+    offset wire — features bit-identical to plain sequential steps."""
+    batches = [wide_ragged_batch(seed=s) for s in (5, 6, 7, 8)]
+    pg = pack_ragged_group(batches)
+    assert pg.layout[2][3] == "u16delta"  # narrow offsets despite wide units
+    back = unpack_batch(pg.buffer, pg.layout)
+    for f in ("units", "offsets", "numeric", "label", "mask"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(back, f)),
+            np.asarray(getattr(stack_batches(batches), f)),
+        )
+    seq = StreamingLinearRegressionWithSGD(num_iterations=5)
+    outs = [seq.step(b) for b in batches]
+    sup = StreamingLinearRegressionWithSGD(num_iterations=5)
+    many = sup.step_many(pg)
+    np.testing.assert_array_equal(sup.latest_weights, seq.latest_weights)
+    for i, out in enumerate(outs):
+        assert float(many.mse[i]) == float(out.mse)
+
+
+def test_superbatcher_group_mode_matches_stacked_end_to_end():
+    """The app grouping path with --wirePack group: identical per-batch
+    stats and final weights as stacked mode, partial tail included (the
+    tail rides the k=1 one-buffer wire)."""
+    from twtml_tpu.apps.common import SuperBatcher
+
+    batches = ragged_batches(n=7)
+
+    def run(mode):
+        model = StreamingLinearRegressionWithSGD(num_iterations=5)
+        seen = []
+        sb = SuperBatcher(
+            model, 3,
+            lambda o, b, t, at_boundary: seen.append(
+                (float(o.count), float(o.mse), at_boundary)
+            ),
+            wire_pack=mode,
+        )
+        for i, b in enumerate(batches):
+            sb.on_batch(b, float(i))
+        sb.flush()
+        return model, seen
+
+    m_group, seen_group = run("group")
+    m_stacked, seen_stacked = run("stacked")
+    assert seen_group == seen_stacked and len(seen_group) == 7
+    np.testing.assert_array_equal(
+        m_group.latest_weights, m_stacked.latest_weights
+    )
+
+
+def test_superbatcher_group_mode_traces_wire_pack_mode(tmp_path):
+    """--trace + --wirePack group: wire_pack spans carry the mode attribute
+    ('group' for full groups, 'single' for the partial tail's k=1 pack)."""
+    from tools import trace_report
+    from twtml_tpu.apps.common import SuperBatcher
+    from twtml_tpu.telemetry import trace
+
+    batches = ragged_batches(n=5)
+    path = str(tmp_path / "wire.trace")
+    trace.install(path)
+    try:
+        model = StreamingLinearRegressionWithSGD(num_iterations=5)
+        sb = SuperBatcher(
+            model, 4, lambda o, b, t, at_boundary: None, wire_pack="group"
+        )
+        for i, b in enumerate(batches):
+            sb.on_batch(b, float(i))
+        sb.flush()
+    finally:
+        trace.uninstall()
+    spans = [
+        e for e in trace_report.load_events(path)
+        if e.get("ph") == "X" and e["name"] == "wire_pack"
+    ]
+    modes = [s["args"]["mode"] for s in spans]
+    assert modes.count("group") == 1  # one full group of 4
+    assert modes.count("single") == 1  # the one-batch partial tail
+    group_span = next(s for s in spans if s["args"]["mode"] == "group")
+    assert group_span["args"]["batches"] == 4
+    assert group_span["args"]["wire_bytes"] > 0
+
+
+# -- narrow offset wire: encode gate + fallback ------------------------------
+
+def test_narrow_offset_wire_flat_bit_identical():
+    rb = ragged_batches(n=1)[0]
+    narrow = pack_batch(rb)  # auto: row_len ≤ 65,535 → u16delta
+    wide = pack_batch(rb, narrow_offsets=False)
+    assert narrow.layout[2][2] == "u16delta"
+    assert wide.layout[2][2] == "i32"
+    assert narrow.buffer.nbytes < wide.buffer.nbytes
+    for pk in (narrow, wide):
+        back = unpack_batch(pk.buffer, pk.layout)
+        for f in ("units", "offsets", "numeric", "label", "mask"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(back, f)), np.asarray(getattr(rb, f))
+            )
+        assert back.offsets.dtype == np.int32
+    # and through the jit step: bitwise-identical outputs either way
+    m_n = StreamingLinearRegressionWithSGD(num_iterations=5)
+    m_w = StreamingLinearRegressionWithSGD(num_iterations=5)
+    out_n, out_w = m_n.step(narrow), m_w.step(wide)
+    for fa, fb in zip(out_n, out_w):
+        np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+    np.testing.assert_array_equal(m_n.latest_weights, m_w.latest_weights)
+
+
+def test_narrow_offset_wire_sharded_bit_identical():
+    from twtml_tpu.features.batch import align_ragged_shards
+
+    rb = ragged_batches(n=1, rows=32)[0]
+    aligned = align_ragged_shards(rb, 4)
+    for mode, marker in ((None, "u16delta"), (False, "i32")):
+        pk = (
+            pack_ragged_sharded(aligned)
+            if mode is None
+            else pack_ragged_sharded(aligned, narrow_offsets=False)
+        )
+        assert pk.layout[2][2] == marker
+        back = unpack_batch(pk.buffer, pk.layout)
+        for f in ("units", "offsets", "numeric", "label", "mask"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(back, f)), np.asarray(getattr(aligned, f))
+            )
+
+
+def test_long_row_trips_int32_fallback():
+    """A row longer than 65,535 units pushes the static row_len bucket past
+    the uint16 delta range: the metadata gate keeps the int32 offsets (no
+    silent wrap), and the wire still trains bit-identically."""
+    rb = long_row_batch()
+    assert not offsets_narrow(rb.row_len)
+    pk = pack_batch(rb)
+    assert pk.layout[2][2] == "i32"  # the auto gate chose the fallback
+    # forcing the narrow wire on an out-of-range batch raises, never wraps
+    with pytest.raises(ValueError, match="uint16-delta"):
+        pack_batch(rb, narrow_offsets=True)
+    with pytest.raises(ValueError, match="uint16-delta"):
+        pack_ragged_group([rb], narrow_offsets=True)
+    # group wire inherits the fallback from the same gate
+    pg = pack_ragged_group([rb])
+    assert pg.layout[2][3] == "i32"
+    back = unpack_batch(pk.buffer, pk.layout)
+    for f in ("units", "offsets", "numeric", "label", "mask"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(back, f)), np.asarray(getattr(rb, f))
+        )
+    m_plain = StreamingLinearRegressionWithSGD(num_iterations=3)
+    m_pack = StreamingLinearRegressionWithSGD(num_iterations=3)
+    out_a, out_b = m_plain.step(rb), m_pack.step(pk)
+    for fa, fb in zip(out_a, out_b):
+        np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+    np.testing.assert_array_equal(
+        m_plain.latest_weights, m_pack.latest_weights
+    )
+
+
+def test_group_pack_rejects_mixed_signatures():
+    a = ragged_batches(n=1, rows=16)[0]
+    b = ragged_batches(n=1, rows=32)[0]
+    with pytest.raises(ValueError, match="share one wire signature"):
+        pack_ragged_group([a, b])
+    with pytest.raises(ValueError, match="empty group"):
+        pack_ragged_group([])
+
+
+# -- wire composition metrics (satellite) ------------------------------------
+
+def test_wire_composition_sums_to_wire_nbytes():
+    rb = ragged_batches(n=1, rows=32)[0]
+    from twtml_tpu.features.batch import align_ragged_shards
+
+    forms = [
+        rb,
+        pack_batch(rb),
+        pack_ragged_sharded(align_ragged_shards(rb, 4)),
+        pack_ragged_group(ragged_batches(n=4, rows=32)),
+    ]
+    for batch in forms:
+        comp = wire_composition(batch)
+        assert set(comp) == {"units", "offsets", "sideband"}
+        assert sum(comp.values()) == wire_nbytes(batch)
+    # the narrow wire's offsets are measurably smaller than the int32 wire
+    narrow = wire_composition(pack_batch(rb))["offsets"]
+    wide = wire_composition(pack_batch(rb, narrow_offsets=False))["offsets"]
+    assert narrow < wide
+
+
+def test_record_metrics_sets_wire_split_gauges():
+    from twtml_tpu.streaming.context import FeatureStream
+    from twtml_tpu.telemetry import metrics as _metrics
+
+    _metrics.reset_for_tests()
+    try:
+        rb = ragged_batches(n=1)[0]
+        FeatureStream._record_metrics(rb)
+        snap = _metrics.get_registry().snapshot()
+        comp = wire_composition(rb)
+        assert snap["gauges"]["wire.units_bytes"] == comp["units"]
+        assert snap["gauges"]["wire.offsets_bytes"] == comp["offsets"]
+        assert snap["gauges"]["wire.sideband_bytes"] == comp["sideband"]
+        assert snap["counters"]["wire.bytes"] == wire_nbytes(rb)
+    finally:
+        _metrics.reset_for_tests()

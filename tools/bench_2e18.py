@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import json
 import os
-import statistics
 import sys
 import time
 
@@ -130,34 +129,28 @@ def main(argv=None) -> None:
     pipeline_arm("padded_b1024", 1024, "padded")
     superbatch_arm("padded_b2048_k8", 2048, 8)
 
-    times: dict[str, list] = {k: [] for k in arms}
-    t_end = time.perf_counter() + budget
-    while time.perf_counter() < t_end:
-        for name, run in arms.items():
-            dt, _ = run()
-            times[name].append(dt)
+    # the house interleaved/paired scheduling (tools/pairedbench.py)
+    from tools.pairedbench import (
+        best_median_rate, paired_ratio_median, run_rounds,
+    )
+
+    times = run_rounds(arms, budget)
 
     out = {"config": "hashing_2e18_l2_sweep", "tweets": n_tweets,
            "backend": jax.default_backend(), "rounds": len(times["padded_b2048"])}
     for name, ts in times.items():
-        out[name] = {
-            "best": round(n_tweets / min(ts), 1),
-            "median": round(n_tweets / statistics.median(ts), 1),
-        }
+        best, median = best_median_rate(ts, n_tweets)
+        out[name] = {"best": best, "median": median}
     base = times["padded_b2048"]
     for name, ts in times.items():
         if name != "padded_b2048":
-            out[name]["paired_speedup_median"] = round(
-                statistics.median([b / t for b, t in zip(base, ts)]), 3
-            )
+            out[name]["paired_speedup_median"] = paired_ratio_median(base, ts)
     # the int8-plane question, answered directly: same wire, same batch,
     # per-round ratios of the bf16-plane arm over the int8-plane arm
     for b in (1024, 2048):
         i8, bf = times.get(f"ragged_b{b}"), times.get(f"ragged_b{b}_bf16")
         if i8 and bf:
-            out[f"int8_vs_bf16_b{b}"] = round(
-                statistics.median([x / y for x, y in zip(bf, i8)]), 3
-            )
+            out[f"int8_vs_bf16_b{b}"] = paired_ratio_median(bf, i8)
     print(json.dumps(out))
 
 

@@ -141,15 +141,20 @@ def main(argv=None) -> None:
     }
 
     # ---- pipelined end-to-end rates, INTERLEAVED -------------------------
-    # The tunnel's health swings on ~10-minute phases (BENCHMARKS.md), so
-    # sequential per-format blocks confound format with phase: alternate
-    # single passes A/B/A/B inside one window and compare paired samples.
-    import statistics
-    import time as _time
-
+    # The house method (tools/pairedbench.py): single passes round-robin
+    # A/B/A/B inside one window, paired per-round ratios — tunnel phase
+    # swings hit both arms equally.
+    from tools.pairedbench import (
+        best_median_rate,
+        paired_ratio_median,
+        paired_ratios,
+        run_rounds,
+    )
     from twtml_tpu.utils.benchloop import _run_once
 
-    def make(featurize):
+    finals: dict[str, float] = {}
+
+    def make(name, featurize):
         if config == "logistic":
             model = StreamingLogisticRegressionWithSGD()
         else:
@@ -160,35 +165,38 @@ def main(argv=None) -> None:
         warm = featurize(chunks[0])
         for _ in range(2):
             float(model.step(warm).mse)  # completion-fetch warmup
-        return model, featurize
+
+        def one_pass():
+            model.reset()
+            dt, last = _run_once(model, featurize, chunks, prefetch=True)
+            finals[name] = round(float(last.mse), 3)
+            return dt
+
+        return one_pass
 
     arms = {
-        "padded": make(fz_padded),
-        "ragged": make(fz_ragged),
+        "padded": make("padded", fz_padded),
+        "ragged": make("ragged", fz_ragged),
     }
     n = sum(
         c.rows if hasattr(c, "rows") else len(c) for c in chunks
     )  # block chunks count rows, Status chunks count items
-    times: dict[str, list] = {k: [] for k in arms}
-    finals: dict[str, float] = {}
-    t_end = _time.perf_counter() + budget
-    while _time.perf_counter() < t_end:
-        for name, (model, featurize) in arms.items():
-            model.reset()
-            dt, last = _run_once(model, featurize, chunks, prefetch=True)
-            times[name].append(dt)
-            finals[name] = round(float(last.mse), 3)
+    times = run_rounds(arms, budget)
     for name, ts in times.items():
+        best, median = best_median_rate(ts, n)
         out[name] = {
-            "tweets_per_sec": round(n / min(ts), 1),
-            "median_tweets_per_sec": round(n / statistics.median(ts), 1),
+            "tweets_per_sec": best,
+            "median_tweets_per_sec": median,
             "passes": len(ts),
             "final_mse": finals[name],
         }
     # paired per-round ratios: phase-robust (each pair shares a window)
-    ratios = [p / r for p, r in zip(times["padded"], times["ragged"])]
-    out["paired_speedup_median"] = round(statistics.median(ratios), 3)
-    out["paired_speedup_all"] = [round(x, 3) for x in ratios]
+    out["paired_speedup_median"] = paired_ratio_median(
+        times["padded"], times["ragged"]
+    )
+    out["paired_speedup_all"] = [
+        round(x, 3) for x in paired_ratios(times["padded"], times["ragged"])
+    ]
     assert out["padded"]["final_mse"] == out["ragged"]["final_mse"], (
         "wire formats diverged — parity violation"
     )

@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import json
 import os
-import statistics
 import sys
 import time
 
@@ -204,27 +203,28 @@ def main(argv=None) -> None:
     if groups:
         super_ragged_pass()  # warm the ragged scan programs (per layout)
 
-    times = {"sync": [], "lag": [], "pool8": [], "fetchpipe": []}
+    # the house interleaved/paired scheduling (tools/pairedbench.py)
+    from tools.pairedbench import (
+        best_median_rate, paired_ratio_median, run_rounds,
+    )
+
+    arms = {
+        "sync": sync_pass, "lag": lag_pass, "pool8": pool_pass,
+        "fetchpipe": fetchpipe_pass,
+    }
     if groups:
-        times["super8_pool4"] = []
-        times["super8_ragged"] = []
-    t_end = time.perf_counter() + budget
-    while time.perf_counter() < t_end:
-        times["sync"].append(sync_pass())
-        times["lag"].append(lag_pass())
-        times["pool8"].append(pool_pass())
-        times["fetchpipe"].append(fetchpipe_pass())
-        if groups:
-            times["super8_pool4"].append(super_pool_pass())
-            times["super8_ragged"].append(super_ragged_pass())
+        arms["super8_pool4"] = super_pool_pass
+        arms["super8_ragged"] = super_ragged_pass
+    times = run_rounds(arms, budget)
 
     out = {"regime": "per-batch-telemetry", "batch": batch,
            "tweets": n_tweets, "backend": jax.default_backend(),
            "rounds": len(times["sync"])}
     for name, ts in times.items():
+        best, median = best_median_rate(ts, n_tweets)
         out[name] = {
-            "tweets_per_sec_best": round(n_tweets / min(ts), 1),
-            "tweets_per_sec_median": round(n_tweets / statistics.median(ts), 1),
+            "tweets_per_sec_best": best,
+            "tweets_per_sec_median": median,
         }
     for name in [
         k
@@ -233,22 +233,14 @@ def main(argv=None) -> None:
         )
         if k in times
     ]:
-        out[name]["paired_speedup_vs_sync"] = round(
-            statistics.median(
-                [s / t for s, t in zip(times["sync"], times[name])]
-            ),
-            3,
+        out[name]["paired_speedup_vs_sync"] = paired_ratio_median(
+            times["sync"], times[name]
         )
     if "super8_ragged" in times:
         # the composition question directly: does the superbatch stack on
         # the shipped ragged fetch pipeline?
-        out["super8_ragged"]["paired_vs_fetchpipe"] = round(
-            statistics.median(
-                [f / t for f, t in zip(
-                    times["fetchpipe"], times["super8_ragged"]
-                )]
-            ),
-            3,
+        out["super8_ragged"]["paired_vs_fetchpipe"] = paired_ratio_median(
+            times["fetchpipe"], times["super8_ragged"]
         )
     print(json.dumps(out))
 

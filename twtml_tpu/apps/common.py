@@ -693,13 +693,27 @@ class SuperBatcher:
     ``deterministic`` (multi-host mode) disables the opportunistic
     already-done early emit, exactly like FetchPipeline's: handler side
     effects then fire only at points driven by the dispatch counter, which
-    advances identically on every lockstep host."""
+    advances identically on every lockstep host.
+
+    ``wire_pack="group"`` (Lean wire v2, ``--wirePack``) coalesces each
+    full group's K ragged batches into ONE contiguous buffer
+    (``features/batch.pack_ragged_group`` — mesh/multi-host models lay it
+    out per shard via ``pack_group_for_wire``) uploaded by a single
+    main-thread put, instead of the stacked wire's K-per-field arrays; the
+    scanned program unpacks the segments in-jit, so the math and the
+    per-batch stats stay bitwise identical (tests/test_superwire.py).
+    Partial groups then pack their single batches through the k=1
+    one-buffer wire (``pack_for_wire``/``pack_batch``) for the same lean
+    layout. Grouping is already by shape signature, so the group layout is
+    a pure function of (signature, K) — one compiled program per group
+    shape, exactly like the stacked wire."""
 
     def __init__(self, model, k: int, handle, fetch_depth: int = 4,
                  boundary_every: int = 0, max_dispatch: int = 0,
                  deterministic: bool = False, abort=None,
                  fetch_deadline_s: float = 0.0,
-                 fetch_retries: "int | None" = None):
+                 fetch_retries: "int | None" = None,
+                 wire_pack: str = "stacked"):
         from concurrent.futures import ThreadPoolExecutor
 
         self.model = model
@@ -708,6 +722,14 @@ class SuperBatcher:
         self.fetch_depth = max(1, fetch_depth)
         self.max_dispatch = max_dispatch
         self.deterministic = deterministic
+        if wire_pack not in ("stacked", "group"):
+            raise ValueError(f"wire_pack must be 'stacked' or 'group', got {wire_pack!r}")
+        self.wire_pack = wire_pack
+        # model-aware coalesced/group packers (mesh models shard the one
+        # buffer; multi-host models assemble it globally); plain models use
+        # the features/batch host packers
+        self._group_packer = getattr(model, "pack_group_for_wire", None)
+        self._single_packer = getattr(model, "pack_for_wire", None)
         # cadence drains count DISPATCHED BATCHES (partial groups included),
         # honoring the pre-r3 contract: the first boundary at/after each
         # cadence point
@@ -779,12 +801,21 @@ class SuperBatcher:
         from ..models.base import StepOutput
 
         future, group, outs = self._inflight.pop(0)
-        host = self._watchdog.await_result(
-            future,
-            lambda: self._pool.submit(
-                self._timed_fetch_many, outs, len(group)
-            ),
-        )
+        try:
+            host = self._watchdog.await_result(
+                future,
+                lambda: self._pool.submit(
+                    self._timed_fetch_many, outs, len(group)
+                ),
+            )
+        except FetchAbort:
+            # the group trained but its outputs are gone with the wedged
+            # tunnel: refund the cap slots so every dispatched batch is
+            # either delivered to the handler or refunded (flush refunds
+            # the remaining in-flight groups the same way)
+            for _ in group:
+                self.refund_dispatch()
+            raise
         last = len(group) - 1
         # _buf is provably empty at every emit site, so the pipeline being
         # drained is the whole weights-current condition
@@ -851,39 +882,91 @@ class SuperBatcher:
         while self._inflight:
             self._emit_group()
 
+    def _coalesce(self, batch) -> bool:
+        """Whether this batch rides the coalesced one-buffer wire (group
+        mode, ragged wire, and a model whose jit program unpacks it)."""
+        from ..features.batch import RaggedUnitBatch
+
+        return (
+            self.wire_pack == "group"
+            and isinstance(batch, RaggedUnitBatch)
+            and getattr(self.model, "accepts_packed", False)
+        )
+
+    def _group_wire(self, batches):
+        """The step_many wire for one full group: the coalesced one-buffer
+        pack (ONE main-thread put; uint16-delta offsets) in group mode, the
+        stacked K-per-field arrays otherwise — bit-identical math either
+        way (tests/test_superwire.py)."""
+        from ..features.batch import (
+            pack_ragged_group, stack_batches, wire_nbytes,
+        )
+
+        if not self._coalesce(batches[0]):
+            return stack_batches(batches)
+        packer = self._group_packer or pack_ragged_group
+        tr = _trace.get()
+        if tr.enabled:
+            with tr.span(
+                "wire_pack", mode="group", batches=len(batches)
+            ) as sp:
+                wire = packer(batches)
+                sp.add(wire_bytes=wire_nbytes(wire))
+            return wire
+        return packer(batches)
+
     def _close_group(self) -> None:
         if not self._buf:
             return
-        import jax
-
-        from ..features.batch import stack_batches
-
         group, self._buf = self._buf, []
         if len(group) < self.k:
             # partial group (tail, or a shape change): plain steps — the
             # same math, and no fresh scan compile for a one-off length.
             # Earlier groups must emit first (strict batch order), and the
             # max_dispatch cap binds here exactly like on full groups.
+            # In group mode the singles still ride the k=1 one-buffer wire
+            # (pack_for_wire / pack_batch), so a partial tail keeps the
+            # coalesced layout's lean offsets.
             self._drain()
             tr = _trace.get()
             for batch, t in group:
                 if self.max_dispatch and self._dispatched >= self.max_dispatch:
                     return
+                wire = batch
+                if self._coalesce(batch):
+                    from ..features.batch import pack_batch
+
+                    packer = self._single_packer or pack_batch
+                    if tr.enabled:
+                        with tr.span("wire_pack", mode="single"):
+                            wire = packer(batch)
+                    else:
+                        wire = packer(batch)
                 _faults.perturb("step")  # --chaos dispatch injection
                 if tr.enabled:
                     with tr.span("dispatch"):
-                        out_dev = self.model.step(batch)
+                        out_dev = self.model.step(wire)
                 else:
-                    out_dev = self.model.step(batch)
+                    out_dev = self.model.step(wire)
+                # dispatch-time accounting, as on the grouped path; if the
+                # awaited fetch aborts, the slot is refunded (the batch
+                # trained but was never delivered — cap accounting follows
+                # deliveries, same rule as _emit_group/flush)
+                self._dispatched += 1
+                self._cadence += 1
                 # same watchdog as the pooled paths (the fetch rides the
                 # pool so the deadline can fire; awaited immediately, so
                 # the partial path stays effectively synchronous)
-                out = self._watchdog.await_result(
-                    self._pool.submit(self._timed_fetch_one, out_dev),
-                    lambda: self._pool.submit(self._timed_fetch_one, out_dev),
-                )
-                self._dispatched += 1
-                self._cadence += 1
+                try:
+                    out = self._watchdog.await_result(
+                        self._pool.submit(self._timed_fetch_one, out_dev),
+                        lambda: self._pool.submit(
+                            self._timed_fetch_one, out_dev
+                        ),
+                    )
+                except FetchAbort:
+                    self.refund_dispatch()
+                    raise
                 self.handle(out, batch, t, at_boundary=True)
             return
         # backpressure + timeliness, as in FetchPipeline (the already-done
@@ -894,16 +977,15 @@ class SuperBatcher:
             and self._inflight and self._inflight[0][0].done()
         ):
             self._emit_group()
+        wire = self._group_wire([b for b, _ in group])
         _faults.perturb("step")  # --chaos dispatch injection
         tr = _trace.get()
         if tr.enabled:
             with tr.span("dispatch", group=len(group),
                          depth=len(self._inflight)):
-                outs = self.model.step_many(
-                    stack_batches([b for b, _ in group])
-                )
+                outs = self.model.step_many(wire)
         else:
-            outs = self.model.step_many(stack_batches([b for b, _ in group]))
+            outs = self.model.step_many(wire)
         self._inflight.append(
             (self._pool.submit(self._timed_fetch_many, outs, len(group)),
              group, outs)
@@ -925,6 +1007,14 @@ class SuperBatcher:
             # already logged + the abort hook fired; the app's shutdown
             # path owns the final checkpoint flush — never raise into it
             if self._inflight or self._buf:
+                # refund the dispatched-but-undelivered batches riding the
+                # dropped in-flight groups (they trained, but their outputs
+                # are gone with the wedged tunnel — cap accounting follows
+                # deliveries; buffered batches never dispatched, nothing to
+                # refund there)
+                for _future, group, _outs in self._inflight:
+                    for _ in group:
+                        self.refund_dispatch()
                 log.warning(
                     "dropping %d in-flight group(s) and %d buffered "
                     "batch(es) after the fetch abort",
@@ -1095,8 +1185,11 @@ class FetchPipeline:
 
             packer = self._packer or pack_batch
             if tr.enabled:
-                with tr.span("wire_pack"):
+                from ..features.batch import wire_nbytes
+
+                with tr.span("wire_pack", mode="single") as sp:
                     wire = packer(batch)
+                    sp.add(wire_bytes=wire_nbytes(wire))
             else:
                 wire = packer(batch)
         else:
@@ -1301,7 +1394,7 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
 
                 packer = getattr(model, "pack_for_wire", None) or pack_batch
                 if tr.enabled:
-                    with tr.span("wire_pack"):
+                    with tr.span("wire_pack", mode="single"):
                         wire = packer(batch)
                 else:
                     wire = packer(batch)
@@ -1335,6 +1428,17 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
         max_dispatch=max_dispatch,
         deterministic=multihost,
         abort=abort,
+        # the coalesced one-buffer group wire applies exactly where the
+        # k=1 pack does (ragged wire + a model that unpacks in-jit);
+        # --wirePack auto resolves to the measured default
+        # (config.effective_wire_pack, BENCHMARKS.md "Lean wire v2")
+        wire_pack=(
+            "group"
+            if pack and getattr(
+                conf, "effective_wire_pack", lambda: "stacked"
+            )() == "group"
+            else "stacked"
+        ),
     )
     if multihost:
         pipeline_ref.append(batcher)  # empty-batch refunds (above)

@@ -142,6 +142,12 @@ class ConfArguments:
         self.chaos: str = conf.get("chaos", "")
         self.webTimeout: float = float(conf.get("webTimeout", "2.0"))
         self.superBatch: int = int(conf.get("superBatch", "1"))
+        self.wirePack: str = conf.get("wirePack", "auto")
+        if self.wirePack not in ("auto", "stacked", "group"):
+            raise ValueError(
+                "wirePack must be 'auto', 'stacked' or 'group', got "
+                f"{self.wirePack!r}"
+            )
         self.recycleAfterMb: int = int(conf.get("recycleAfterMb", "0"))
 
         # Multi-host process group (the reference's one-flag cluster story,
@@ -265,6 +271,15 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                                                dispatch (one scan, one stats fetch; per-batch
                                                stats preserved; stops/checkpoints land on group
                                                boundaries). Default: {self.superBatch}
+  --wirePack <auto|stacked|group>              Superbatch wire layout on the ragged wire:
+                                               'group' coalesces the K batches into ONE
+                                               contiguous buffer (one put; uint16-delta offsets)
+                                               unpacked inside the scanned program; 'stacked'
+                                               ships K per-field arrays. auto = the measured
+                                               winner recorded in BENCHMARKS.md "Lean wire v2"
+                                               (currently stacked pending a tunnel-regime
+                                               verdict; bit-identical features either way).
+                                               Default: {self.wirePack}
 """
 
     def parse(self, args: list[str]) -> "ConfArguments":
@@ -354,6 +369,10 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
             self.trace = take()
         elif flag == "--superBatch":
             self.superBatch = int(take())
+        elif flag == "--wirePack":
+            self.wirePack = take()
+            if self.wirePack not in ("auto", "stacked", "group"):
+                self.printUsage(1)
         elif flag == "--recycleAfterMb":
             self.recycleAfterMb = int(take())
         elif flag == "--faultEvery":
@@ -392,6 +411,21 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
         if self.hashOn != "device" or self.seconds > 0:
             return "padded"
         return "ragged"
+
+    def effective_wire_pack(self) -> str:
+        """Resolve ``--wirePack auto`` to the measured-default superbatch
+        wire layout. The coalesced group wire (one contiguous buffer per K
+        batches, uint16-delta offsets) is bit-identical to the stacked wire
+        and composes the two measured transfer facts (bandwidth improves
+        with size; packing the lean wire paid +11.4%), but the r2/r3 law —
+        measure in the target regime before shipping a wire/dispatch
+        change — holds the default at STACKED until the tunnel-regime bench
+        clears (tools/bench_superwire.py; BENCHMARKS.md "Lean wire v2"
+        records the CPU control, which is wire-insensitive by design).
+        Explicit ``--wirePack group``/``stacked`` always wins."""
+        if self.wirePack != "auto":
+            return self.wirePack
+        return "stacked"
 
     def local_shards(self) -> int | None:
         """Parse Spark-style local[N] master hints; None means use all devices."""

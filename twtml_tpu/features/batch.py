@@ -252,6 +252,63 @@ def wire_nbytes(batch) -> int:
     return total
 
 
+def wire_composition(batch) -> "dict[str, int]":
+    """The per-batch wire split {units, offsets, sideband} in bytes — what
+    the Lean-wire-v2 offset shrink moves, surfaced as gauges in the metrics
+    registry (streaming/context.py) so /api/metrics and trace reports show
+    the wire composition without a bench run. ``units`` is the text
+    payload (code units, or hashed token idx/val on the host-hash wire),
+    ``offsets`` the row-boundary sideband (offsets/length deltas), and
+    ``sideband`` the numeric/label/mask tail. A PackedBatch reports its
+    layout's recorded fields (× segment count), so the packed and unpacked
+    views of one batch agree byte-for-byte."""
+    if isinstance(batch, PackedBatch):
+        tag = batch.layout[0]
+        if tag in ("RaggedShardSegments", "RaggedGroupSegments"):
+            segs = 1
+            per_seg = sum(
+                int(np.prod(shape, dtype=np.int64)) * np.dtype(dt).itemsize
+                for shape, dt in batch.layout[1]
+            )
+            if per_seg:
+                segs = int(batch.buffer.shape[0]) // per_seg
+            names = ("units", "offsets", "sideband", "sideband", "sideband")
+        else:
+            names = {
+                "FeatureBatch": (
+                    "units", "units", "sideband", "sideband", "sideband"
+                ),
+                "UnitBatch": (
+                    "units", "offsets", "sideband", "sideband", "sideband"
+                ),
+                "RaggedUnitBatch": (
+                    "units", "offsets", "sideband", "sideband", "sideband"
+                ),
+            }[tag]
+            segs = 1
+        out = {"units": 0, "offsets": 0, "sideband": 0}
+        for name, (shape, dt) in zip(names, batch.layout[1]):
+            out[name] += segs * int(
+                np.prod(shape, dtype=np.int64)
+            ) * np.dtype(dt).itemsize
+        return out
+    groups = {
+        "units": ("units", "token_idx", "token_val"),
+        "offsets": ("offsets", "length"),
+        "sideband": ("numeric", "label", "mask"),
+    }
+    out = {}
+    for name, attrs in groups.items():
+        total = 0
+        for attr in attrs:
+            arr = getattr(batch, attr, None)
+            nbytes = getattr(arr, "nbytes", None)
+            if nbytes is not None:
+                total += int(nbytes)
+        out[name] = total
+    return out
+
+
 def _shard_segment_need(rb: "RaggedUnitBatch", num_shards: int) -> int:
     """Raw units each shard segment must hold (the longest shard's real
     units) — the ONE shard-boundary computation align/bucket share."""
@@ -349,6 +406,60 @@ def align_ragged_shards(
 # B·mean_len, so real streams hit one or two buckets)
 RAGGED_UNIT_MULTIPLE = 4096
 
+# ---- narrow offset wire (Lean wire v2) ------------------------------------
+# The ragged wire's [B+1] int32 offsets are pure sideband: every row length
+# is bounded by the STATIC rebuilt row length L (``row_len`` — the
+# featurizer's bucket policy guarantees lengths ≤ L), so whenever L fits
+# uint16 the offsets can ship as per-row LENGTH DELTAS in half the bytes
+# minus four per segment (b16384: 65,540 → 32,768 bytes). The device
+# cumsums them back to segment-relative offsets in-program
+# (ops/ragged.offsets_from_deltas) — a pure re-encoding, bit-identical
+# features. The gate is static per program, exactly like the uint8/uint16
+# units switch: a schema property of the layout, never sniffed per batch,
+# with the int32 path as the metadata-gated fallback for row_len > 65,535.
+OFFSET_DELTA_MAX = 2**16 - 1
+
+
+def offsets_narrow(row_len: int) -> bool:
+    """Whether this batch's offsets may ship as uint16 length deltas —
+    static in ``row_len`` (see OFFSET_DELTA_MAX note)."""
+    return 0 < int(row_len) <= OFFSET_DELTA_MAX
+
+
+def _offsets_to_deltas(offsets, num_segments: int) -> np.ndarray:
+    """Segment-relative int32 offsets [S·(B_s+1)] → uint16 per-row length
+    deltas [S·B_s] (the narrow offset wire). Each segment's offsets start
+    at 0 by construction (ragged_wire_arrays / align_ragged_shards), so the
+    deltas are lossless; a delta that overflows uint16 means the caller's
+    ``row_len`` gate was misdeclared — raise, never wrap."""
+    offs = np.asarray(offsets, np.int64).reshape(num_segments, -1)
+    d = offs[:, 1:] - offs[:, :-1]
+    if d.size and (d.min() < 0 or d.max() > OFFSET_DELTA_MAX):
+        raise ValueError(
+            "offsets are not uint16-delta encodable (negative or "
+            f"> {OFFSET_DELTA_MAX} length); keep the int32 offset wire"
+        )
+    return d.astype(np.uint16).reshape(-1)
+
+
+def _deltas_to_offsets_np(deltas, num_segments: int) -> np.ndarray:
+    """Host twin of ``ops/ragged.offsets_from_deltas``."""
+    d = np.asarray(deltas, np.int64).reshape(num_segments, -1)
+    out = np.zeros((num_segments, d.shape[1] + 1), np.int64)
+    np.cumsum(d, axis=1, out=out[:, 1:])
+    return out.reshape(-1).astype(np.int32)
+
+
+def _decode_offsets(arr, num_segments: int):
+    """Delta-wire decode for ``unpack_batch``: host numpy cumsums here; a
+    traced device array cumsums in-program (ops/ragged.offsets_from_deltas)
+    — either way the rebuilt offsets are bit-identical to the int32 wire."""
+    if isinstance(arr, np.ndarray):
+        return _deltas_to_offsets_np(arr, num_segments)
+    from ..ops.ragged import offsets_from_deltas
+
+    return offsets_from_deltas(arr, num_segments)
+
 
 def ragged_wire_arrays(
     units: np.ndarray, offsets: np.ndarray, n: int, b: int, narrow: bool
@@ -371,7 +482,8 @@ def ragged_wire_arrays(
 
 
 def pack_ragged_sharded(
-    rb: "RaggedUnitBatch", num_shards_out: int = 0
+    rb: "RaggedUnitBatch", num_shards_out: int = 0,
+    narrow_offsets: "bool | None" = None,
 ) -> PackedBatch:
     """A SHARD-ALIGNED ragged batch → one wire buffer laid out PER SHARD, so
     a mesh data axis can shard the single buffer (r5: the +11.4% packing
@@ -391,16 +503,30 @@ def pack_ragged_sharded(
     every process, so the layout must carry the GLOBAL count. ``s = 1`` is
     legal (a 1-device mesh, or the one-data-shard-per-process topology):
     the "per-shard" layout is then simply the whole local batch as one
-    segment."""
+    segment.
+
+    ``narrow_offsets`` (default: auto from the static ``row_len`` gate,
+    ``offsets_narrow``) ships the per-shard offsets as uint16 LENGTH DELTAS
+    instead of [B_s+1] int32 — the Lean-wire-v2 sideband shrink; the unpack
+    cumsums them back in-program, bit-identically."""
     s = rb.num_shards
     b = rb.mask.shape[0]
     bl = b // s
     n_sb = rb.units.shape[0] // s
+    narrow = (
+        offsets_narrow(rb.row_len) if narrow_offsets is None
+        else narrow_offsets
+    )
+    offs_wire = (
+        (_offsets_to_deltas(rb.offsets, s), (bl,))
+        if narrow
+        else (rb.offsets, (bl + 1,))
+    )
     fields = tuple(
         np.ascontiguousarray(np.asarray(a).reshape((s,) + shape))
         for a, shape in (
             (rb.units, (n_sb,)),
-            (rb.offsets, (bl + 1,)),
+            offs_wire,
             (rb.numeric, (bl, NUM_NUMBER_FEATURES)),
             (rb.label, (bl,)),
             (rb.mask, (bl,)),
@@ -409,7 +535,7 @@ def pack_ragged_sharded(
     layout = (
         "RaggedShardSegments",
         tuple((f.shape[1:], f.dtype.str) for f in fields),
-        (rb.row_len, num_shards_out or s),
+        (rb.row_len, num_shards_out or s, "u16delta" if narrow else "i32"),
     )
     buffer = np.concatenate(
         [f.view(np.uint8).reshape(s, -1) for f in fields], axis=1
@@ -422,9 +548,12 @@ def _unpack_ragged_shards(buffer, layout: tuple) -> "RaggedUnitBatch":
     full S-segment buffer back as the shard-aligned batch; inside a
     shard_map body the local slice holds ONE segment and rebuilds the
     shard-local batch (num_shards=1 — the body is per-shard by
-    construction)."""
+    construction). A ``u16delta`` layout (narrow offset wire) cumsums the
+    per-row length deltas back to segment-relative offsets here —
+    in-program on device, numpy on host — before the batch is rebuilt."""
     fields_meta = layout[1]
-    row_len, s_total = layout[2]
+    row_len, s_total = layout[2][0], layout[2][1]
+    offs_mode = layout[2][2] if len(layout[2]) > 2 else "i32"
     per_shard = sum(
         int(np.prod(shape, dtype=np.int64)) * np.dtype(dt).itemsize
         for shape, dt in fields_meta
@@ -461,6 +590,167 @@ def _unpack_ragged_shards(buffer, layout: tuple) -> "RaggedUnitBatch":
         off += nbytes
         # flatten the segment axis back into the leading dim
         fields.append(arr.reshape((arr.shape[0] * shape[0],) + shape[1:]))
+    if offs_mode == "u16delta":
+        fields[1] = _decode_offsets(fields[1], s_here)
+    return RaggedUnitBatch(
+        *fields, row_len=row_len, num_shards=s_here if s_here > 1 else 1
+    )
+
+
+def pack_ragged_group(
+    batches, num_shards_out: int = 0,
+    narrow_offsets: "bool | None" = None,
+) -> PackedBatch:
+    """K same-signature ragged batches → ONE contiguous uint8 wire buffer
+    (the coalesced superbatch wire, Lean wire v2).
+
+    Why: upload bandwidth through the tunnel IMPROVES with transfer size
+    and packing the lean ragged wire paid +11.4% (BENCHMARKS.md), yet the
+    stacked superbatch wire still shipped K separate per-field arrays —
+    K small puts where one large coalesced put rides the bandwidth curve.
+    This pack composes the two measured facts: the K batches' five fields
+    flatten into one buffer with a STATIC per-group layout, uploaded by
+    ONE main-thread ``device_put`` (rides the step_many dispatch), and the
+    in-jit unpack (``_unpack_ragged_group``) slices the K segments back
+    into the stacked [K, ...] leaves the existing scanned K-step program
+    consumes — bit-identical features, differential-tested against the
+    K-separate-wires path (tests/test_superwire.py).
+
+    Layout: the buffer is laid out SHARD-MAJOR, [S, K, per-segment bytes]
+    flattened — ``P(data)`` on the one buffer then hands each device its
+    own K segments (the shard-aligned variant of the one-buffer wire,
+    parallel/sharding.py), with S = 1 collapsing to the single-device
+    [K, per-batch] layout. Offsets ride the narrow uint16-delta wire under
+    the same static ``row_len`` gate as ``pack_ragged_sharded``.
+
+    All batches must share one wire signature (shapes, dtypes, row_len,
+    shard alignment) — the SuperBatcher's signature grouping guarantees
+    this, so each distinct (signature, K) compiles exactly one program.
+    ``num_shards_out`` mirrors ``pack_ragged_sharded`` (multi-host callers
+    pack local shards, the layout carries the global count)."""
+    if not batches:
+        raise ValueError("cannot pack an empty group")
+    first = batches[0]
+    if not isinstance(first, RaggedUnitBatch):
+        raise TypeError("pack_ragged_group is the ragged wire's group pack")
+    k = len(batches)
+    for rb in batches[1:]:
+        if (
+            not isinstance(rb, RaggedUnitBatch)
+            or (rb.row_len, rb.num_shards) != (first.row_len, first.num_shards)
+            or rb.units.shape != first.units.shape
+            or rb.units.dtype != first.units.dtype
+            or rb.mask.shape != first.mask.shape
+        ):
+            raise ValueError(
+                "group batches must share one wire signature (shapes, "
+                "dtypes, row_len, shard alignment)"
+            )
+    s = first.num_shards
+    b = first.mask.shape[0]
+    bl = b // s
+    n_sb = first.units.shape[0] // s
+    narrow = (
+        offsets_narrow(first.row_len) if narrow_offsets is None
+        else narrow_offsets
+    )
+    specs = (
+        ((lambda rb: rb.units), (n_sb,)),
+        (
+            (lambda rb: _offsets_to_deltas(rb.offsets, s))
+            if narrow else (lambda rb: rb.offsets),
+            (bl,) if narrow else (bl + 1,),
+        ),
+        ((lambda rb: rb.numeric), (bl, NUM_NUMBER_FEATURES)),
+        ((lambda rb: rb.label), (bl,)),
+        ((lambda rb: rb.mask), (bl,)),
+    )
+    # [S, K, ...] per field: shard-major so P(data) on the flattened buffer
+    # hands each device exactly its own K segments
+    fields = tuple(
+        np.ascontiguousarray(np.stack(
+            [np.asarray(get(rb)).reshape((s,) + shape) for rb in batches],
+            axis=1,
+        ))
+        for get, shape in specs
+    )
+    layout = (
+        "RaggedGroupSegments",
+        tuple((f.shape[2:], f.dtype.str) for f in fields),
+        (
+            first.row_len, num_shards_out or s, k,
+            "u16delta" if narrow else "i32",
+        ),
+    )
+    buffer = np.concatenate(
+        [f.view(np.uint8).reshape(s, k, -1) for f in fields], axis=2
+    ).reshape(-1)
+    return PackedBatch(buffer, layout)
+
+
+def _decode_offsets_stacked(arr, s_here: int):
+    """Stacked [K, S·B_s] delta wire → [K, S·(B_s+1)] int32 offsets."""
+    if isinstance(arr, np.ndarray):
+        k = arr.shape[0]
+        return _deltas_to_offsets_np(
+            arr.reshape(k * s_here, -1), k * s_here
+        ).reshape(k, -1)
+    from ..ops.ragged import offsets_from_deltas
+
+    return offsets_from_deltas(arr, s_here)
+
+
+def _unpack_ragged_group(buffer, layout: tuple) -> "RaggedUnitBatch":
+    """Rebuild the STACKED ragged batch ([K, ...] leaves — what
+    ``stack_batches`` would have produced) from a ``RaggedGroupSegments``
+    buffer. Host numpy gets the full group back shard-aligned; inside a
+    jit program (single device, or a shard_map body's local slice) the
+    buffer holds ONE shard's K segments and the zero-copy bitcasts rebuild
+    the shard-local stacked batch the scanned step consumes."""
+    fields_meta = layout[1]
+    row_len, _s_total, k, offs_mode = layout[2]
+    per_seg = sum(
+        int(np.prod(shape, dtype=np.int64)) * np.dtype(dt).itemsize
+        for shape, dt in fields_meta
+    )
+    s_here = buffer.shape[0] // (k * per_seg)
+    if buffer.shape[0] != s_here * k * per_seg:
+        raise ValueError(
+            f"buffer of {buffer.shape[0]} bytes is not a whole number of "
+            f"{k}x{per_seg}-byte group segments"
+        )
+    fields = []
+    off = 0
+    for shape, dtype_str in fields_meta:
+        dt = np.dtype(dtype_str)
+        count = int(np.prod(shape, dtype=np.int64))
+        nbytes = count * dt.itemsize
+        if isinstance(buffer, np.ndarray):
+            chunk = np.ascontiguousarray(
+                buffer.reshape(s_here, k, per_seg)[:, :, off : off + nbytes]
+            )
+            arr = chunk.view(dt).reshape((s_here, k) + shape)
+            # [S, K, d0, ...] → [K, S·d0, ...]: K leads (the scan axis),
+            # the segment axis folds back into each leaf's leading dim
+            arr = np.ascontiguousarray(
+                arr.transpose((1, 0) + tuple(range(2, arr.ndim)))
+            ).reshape((k, s_here * shape[0]) + shape[1:])
+        else:
+            from jax import lax
+
+            if s_here != 1:
+                raise ValueError(
+                    "device-side group unpack sees exactly one shard "
+                    "segment (the shard_map-local slice)"
+                )
+            chunk = buffer.reshape(k, per_seg)[:, off : off + nbytes]
+            if dt.itemsize > 1:
+                chunk = chunk.reshape(k, count, dt.itemsize)
+            arr = lax.bitcast_convert_type(chunk, dt).reshape((k,) + shape)
+        off += nbytes
+        fields.append(arr)
+    if offs_mode == "u16delta":
+        fields[1] = _decode_offsets_stacked(fields[1], s_here)
     return RaggedUnitBatch(
         *fields, row_len=row_len, num_shards=s_here if s_here > 1 else 1
     )
@@ -468,16 +758,32 @@ def _unpack_ragged_shards(buffer, layout: tuple) -> "RaggedUnitBatch":
 
 def pack_batch(
     batch: "FeatureBatch | UnitBatch | RaggedUnitBatch",
+    narrow_offsets: "bool | None" = None,
 ) -> PackedBatch:
     """Flatten a host batch into one uint8 wire buffer (cheap memcpy).
     RaggedUnitBatch packs its five arrays too, with ``row_len`` carried in
-    the static layout (third element)."""
+    the static layout (third element) — and its offsets ship as uint16
+    length deltas whenever the static ``row_len`` gate allows
+    (``offsets_narrow``; the in-jit unpack cumsums them back,
+    bit-identically — the Lean-wire-v2 sideband shrink)."""
     if isinstance(batch, RaggedUnitBatch):
+        narrow = (
+            offsets_narrow(batch.row_len) if narrow_offsets is None
+            else narrow_offsets
+        )
+        offs = (
+            _offsets_to_deltas(batch.offsets, batch.num_shards)
+            if narrow
+            else batch.offsets
+        )
         arrays: tuple = (
-            batch.units, batch.offsets, batch.numeric, batch.label,
+            batch.units, offs, batch.numeric, batch.label,
             batch.mask,
         )
-        extra: "tuple | None" = (batch.row_len, batch.num_shards)
+        extra: "tuple | None" = (
+            batch.row_len, batch.num_shards,
+            "u16delta" if narrow else "i32",
+        )
     else:
         arrays = tuple(batch)
         extra = None
@@ -495,6 +801,8 @@ def unpack_batch(buffer, layout: tuple):
     (bitcast + reshape; no data movement) and on host numpy alike."""
     if layout[0] == "RaggedShardSegments":
         return _unpack_ragged_shards(buffer, layout)
+    if layout[0] == "RaggedGroupSegments":
+        return _unpack_ragged_group(buffer, layout)
     cls = {
         "FeatureBatch": FeatureBatch,
         "UnitBatch": UnitBatch,
@@ -519,10 +827,13 @@ def unpack_batch(buffer, layout: tuple):
         fields.append(arr)
     if cls is RaggedUnitBatch:
         extra = layout[2]
+        num_shards = extra[1] if len(extra) > 1 else 1
+        if len(extra) > 2 and extra[2] == "u16delta":
+            fields[1] = _decode_offsets(fields[1], num_shards)
         return RaggedUnitBatch(
             *fields,
             row_len=extra[0],
-            num_shards=extra[1] if len(extra) > 1 else 1,
+            num_shards=num_shards,
         )
     return cls(*fields)
 

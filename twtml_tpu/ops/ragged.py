@@ -22,7 +22,28 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def ragged_repad(units, offsets, row_len: int, rows: int | None = None):
+def offsets_from_deltas(deltas, num_segments: int = 1):
+    """uint16 per-row length deltas → segment-relative int32 offsets, in
+    program — the decode half of the NARROW offset wire (Lean wire v2:
+    features/batch.py ships offsets as length deltas in half the sideband
+    bytes whenever the static ``row_len`` gate allows; this cumsum rebuilds
+    the exact offsets, so every downstream consumer — ``ragged_repad``
+    first — sees the int32 wire bit-identically).
+
+    Shapes: [..., S·B_s] → [..., S·(B_s+1)] (leading axes pass through —
+    a stacked [K, B] superbatch wire decodes to [K, B+1] in one call).
+    Each segment's offsets start at 0 by construction
+    (``ragged_wire_arrays`` / ``align_ragged_shards``), which is what makes
+    the delta encoding lossless."""
+    lead = deltas.shape[:-1]
+    d = deltas.astype(jnp.int32).reshape(lead + (num_segments, -1))
+    zero = jnp.zeros(lead + (num_segments, 1), jnp.int32)
+    out = jnp.concatenate([zero, jnp.cumsum(d, axis=-1)], axis=-1)
+    return out.reshape(lead + (-1,))
+
+
+def ragged_repad(units, offsets, row_len: int, rows: int | None = None,
+                 deltas: bool = False):
     """(flat units [N], offsets, static L) → (padded int32 [B, L]
     case-folded units, int32 [B] lengths) — the padded-wire layout, on
     device.
@@ -33,7 +54,17 @@ def ragged_repad(units, offsets, row_len: int, rows: int | None = None):
     (S = 1 when offsets is the plain [B + 1] vector; None means plain).
     Segment s's sub-buffer starts at s·(N/S) and its offsets are
     segment-relative, so converting to absolute starts is one broadcast
-    add — the gather itself is identical in every layout."""
+    add — the gather itself is identical in every layout.
+
+    ``deltas=True`` accepts the NARROW offset wire directly: ``offsets``
+    then holds uint16 per-row length deltas ([B], one segment per
+    ``rows``-worth of deltas is impossible to infer from size, so callers
+    on the multi-segment layout decode via ``offsets_from_deltas`` first)
+    and the cumsum happens here, in-program — the repad result is
+    bit-identical to the int32 wire."""
+    if deltas:
+        offsets = offsets_from_deltas(offsets)
+        rows = None
     offs = offsets.astype(jnp.int32)
     n_segments = 1 if rows is None else offsets.shape[0] - rows
     if n_segments > 1:

@@ -218,15 +218,44 @@ class MultiHostSGDModel:
         )
         return PackedBatch(buf, pb.layout)
 
+    def pack_group_for_wire(self, batches):
+        """Multi-host form of the COALESCED superbatch wire: align each of
+        the K local batches to this host's LOCAL shard segments (agreed
+        bucket — uniform per-segment bytes on every host), pack them
+        shard-major into one local buffer (``pack_ragged_group``), and
+        assemble the global buffer from every process's contribution —
+        exactly the ``pack_for_wire`` assembly, K segments deep. The
+        per-process block is this host's local shards' [K, per-segment]
+        bytes, so the shard-major global layout is contiguous per process
+        and the data axis shards it like the single-group wire."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..features.batch import PackedBatch, pack_ragged_group
+
+        aligned = [_ragged_local_aligned(b, self.mesh) for b in batches]
+        pb = pack_ragged_group(aligned, num_shards_out=self.num_data)
+        sharding = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+        buf = jax.make_array_from_process_local_data(
+            sharding, pb.buffer,
+            (pb.buffer.shape[0] * jax.process_count(),),
+        )
+        return PackedBatch(buf, pb.layout)
+
     def step_many(self, stacked):
         """K-batch group over the multi-host mesh: the app pre-aligns and
         harmonizes each LOCAL batch (``prepare``), the SuperBatcher stacks
         K of them, and this assembles ONE global stacked batch ([K, ...]
         leaves, rows sharded on axis 1) for the mesh scan — one dispatch
-        and one pooled stats fetch per K batches, multi-host included."""
+        and one pooled stats fetch per K batches, multi-host included. A
+        PackedBatch from ``pack_group_for_wire`` is already the assembled
+        global coalesced wire — straight to the mesh scan."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from ..features.batch import PackedBatch
         from .sharding import _pspecs_for, _stacked
+
+        if isinstance(stacked, PackedBatch):
+            return self.inner.step_many(stacked)
 
         data_axis = self.mesh.axis_names[0]
 

@@ -445,24 +445,36 @@ class ParallelSGDModel:
         """The superbatch program: lax.scan of the per-shard step body over a
         stacked batch ([K, ...] leaves; K unsharded, rows sharded as usual).
         Same math as K sequential steps — the scan carries the weights
-        through the identical body (mirrors StreamingSGDModel.step_many)."""
+        through the identical body (mirrors StreamingSGDModel.step_many).
+
+        A PackedBatch here is the COALESCED group wire
+        (``pack_ragged_group``: one shard-major buffer whose local slice
+        holds this shard's K segments): the body unpacks the slice into the
+        stacked shard-local batch in-program — zero-copy bitcasts plus the
+        narrow-offset cumsum — and runs the identical scan."""
         key = (batch_cls, "scan")
         fn = self._sharded.get(key)
         if fn is None:
             body = self._step_body
+            if batch_cls is PackedBatch:
+                def scanned(weights, pb, _inner=body):
+                    return lax.scan(
+                        _inner, weights, unpack_batch(pb.buffer, pb.layout)
+                    )
 
-            def scanned(weights, stacked_batch):
-                return lax.scan(body, weights, stacked_batch)
+                in_spec = _pspecs_for(PackedBatch, self.data_axis)
+            else:
+                def scanned(weights, stacked_batch):
+                    return lax.scan(body, weights, stacked_batch)
+
+                in_spec = _stacked(_pspecs_for(batch_cls, self.data_axis))
 
             from ..utils import shard_map
 
             sharded = shard_map()(
                 scanned,
                 mesh=self.mesh,
-                in_specs=(
-                    self._w_spec,
-                    _stacked(_pspecs_for(batch_cls, self.data_axis)),
-                ),
+                in_specs=(self._w_spec, in_spec),
                 out_specs=(self._out_specs[0], _stacked(self._out_specs[1])),
             )
             fn = jax.jit(sharded, donate_argnums=0)
@@ -570,12 +582,31 @@ class ParallelSGDModel:
             pb.layout,
         )
 
-    def _packed_rows(self, pb: PackedBatch) -> int:
-        """Global row count recorded in a RaggedShardSegments layout."""
-        if pb.layout[0] != "RaggedShardSegments":
+    def pack_group_for_wire(self, batches) -> PackedBatch:
+        """The mesh form of the COALESCED superbatch wire (Lean wire v2):
+        shard-align each of the K batches, pack them into ONE shard-major
+        buffer (``pack_ragged_group``) and place it with row sharding —
+        one main-thread put whose P(data) slice hands every device its own
+        K segments; ``step_many`` consumes it via the scanned unpack."""
+        from ..features.batch import pack_ragged_group
+
+        pb = pack_ragged_group([self.prepare(b) for b in batches])
+        return PackedBatch(
+            jax.device_put(
+                pb.buffer, NamedSharding(self.mesh, P(self.data_axis))
+            ),
+            pb.layout,
+        )
+
+    def _packed_rows(self, pb: PackedBatch, group: bool = False) -> int:
+        """Global row count recorded in a RaggedShardSegments (or, for the
+        coalesced superbatch wire, RaggedGroupSegments) layout."""
+        want = "RaggedGroupSegments" if group else "RaggedShardSegments"
+        if pb.layout[0] != want:
             raise ValueError(
                 "mesh models take the per-shard packed layout "
-                "(pack_for_wire), not the flat pack_batch buffer"
+                f"({'pack_group_for_wire' if group else 'pack_for_wire'}), "
+                "not the flat pack_batch buffer"
             )
         s = pb.layout[2][1]
         if s != self.num_data:
@@ -612,14 +643,29 @@ class ParallelSGDModel:
         return out
 
     def step_many(
-        self, stacked: FeatureBatch | UnitBatch | RaggedUnitBatch
+        self, stacked: FeatureBatch | UnitBatch | RaggedUnitBatch | PackedBatch
     ) -> StepOutput:
         """K micro-batch steps as one dispatch over the mesh (superbatch:
         ``features.batch.stack_batches``); per-batch stats return along
         axis 0. Stacked ragged batches must be shard-aligned per batch
         (``prepare`` before stacking) and are placed explicitly; already-
-        global arrays (multi-host assembly) pass through. See
-        ``_scan_for``."""
+        global arrays (multi-host assembly) pass through. A PackedBatch is
+        the coalesced group wire (``pack_group_for_wire``) — one buffer,
+        unpacked inside the scanned program. See ``_scan_for``."""
+        if isinstance(stacked, PackedBatch):
+            self._check_rows(self._packed_rows(stacked, group=True))
+            if not isinstance(stacked.buffer, jax.Array):
+                stacked = PackedBatch(
+                    jax.device_put(
+                        stacked.buffer,
+                        NamedSharding(self.mesh, P(self.data_axis)),
+                    ),
+                    stacked.layout,
+                )
+            self._weights, outs = self._scan_for(PackedBatch)(
+                self._weights, stacked
+            )
+            return outs
         self._check_rows(stacked.mask.shape[1])
         if isinstance(stacked, RaggedUnitBatch) and not isinstance(
             stacked.units, jax.Array

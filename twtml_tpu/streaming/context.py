@@ -233,12 +233,19 @@ class FeatureStream(RawStream):
 
     @staticmethod
     def _record_metrics(batch) -> None:
-        from ..features.batch import wire_nbytes
+        from ..features.batch import wire_composition, wire_nbytes
 
         reg = _metrics.get_registry()
         reg.counter("pipeline.batches").inc()
         reg.counter("pipeline.tweets").inc(batch.num_valid)
         reg.counter("wire.bytes").inc(wire_nbytes(batch))
+        # per-batch wire composition (Lean wire v2): the units/offsets/
+        # sideband split makes the offset-narrowing visible in /api/metrics
+        # and trace reports without a bench run
+        comp = wire_composition(batch)
+        reg.gauge("wire.units_bytes").set(comp["units"])
+        reg.gauge("wire.offsets_bytes").set(comp["offsets"])
+        reg.gauge("wire.sideband_bytes").set(comp["sideband"])
 
     def _featurize_impl(self, statuses: list) -> "FeatureBatch | UnitBatch":
         from ..features.blocks import ParsedBlock, merge_blocks

@@ -45,7 +45,11 @@ STAGES = (
     "source_read",   # queue drain on the batch scheduler
     "parse",         # bytes/lines → Status/ParsedBlock, on the source thread
     "featurize",     # host featurize incl. wire build (FeatureStream)
-    "wire_pack",     # one-buffer pack of the ragged wire (when --wire ragged)
+    "wire_pack",     # one-buffer pack of the ragged wire (when --wire
+                     # ragged); carries a ``mode`` attribute — "single"
+                     # (the k=1 pack) or "group" (the coalesced superbatch
+                     # wire, --wirePack group) — plus ``wire_bytes``, so
+                     # trace reports show the Lean-wire-v2 layout in use
     "dispatch",      # model.step dispatch — argument uploads ride this
     "fetch",         # pipelined StepOutput host fetch (FetchPipeline pool)
     "stats_publish", # telemetry POSTs (SessionStats)
