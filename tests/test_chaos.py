@@ -88,10 +88,82 @@ def test_targets_are_independent():
     "fetch:error@p0",  # probability out of range
     "fetch:error@0",  # every-0th
     "fetch:error@from0",  # from-0th
+    "fetch",  # transport targets need an action
+    "source.nan:error",  # source targets take no action
+    "source.nan:rows=4",  # rows= is burst-only
+    "source.garbage:delay=1",  # no transport actions on source targets
+    "source.burst:rows=0",  # non-positive burst
+    "source.frob",  # unknown source target
 ])
 def test_malformed_specs_are_rejected(bad):
     with pytest.raises(ValueError):
         ChaosInjector(bad)
+
+
+# -- source-chaos grammar (r7: the ingest-guard failure domain) --------------
+
+def test_source_targets_parse_bare_with_trigger():
+    inj = ChaosInjector("source.nan@3")
+    fired = [inj.should("source.nan") is not None for _ in range(9)]
+    assert [i + 1 for i, f in enumerate(fired) if f] == [3, 6, 9]
+    reg = _metrics.get_registry()
+    assert reg.counter("chaos.source.nan.injected").snapshot() == 3
+    assert reg.counter("chaos.injected").snapshot() == 3
+
+
+def test_burst_rows_magnitude_and_default():
+    inj = ChaosInjector("source.burst:rows=8@2")
+    assert inj.should("source.burst") is None
+    assert inj.should("source.burst") == 8
+    inj = ChaosInjector("source.burst")
+    assert inj.should("source.burst") == faults.BURST_DEFAULT_EXTRA
+
+
+def test_should_never_raises_or_sleeps():
+    inj = ChaosInjector("source.garbage@1")
+    t0 = time.perf_counter()
+    for _ in range(100):
+        assert inj.should("source.garbage") == faults.BURST_DEFAULT_EXTRA
+    assert time.perf_counter() - t0 < 0.5
+    assert inj.should("fetch") is None  # no rules for that target
+
+
+def test_source_and_transport_rules_compose():
+    inj = ChaosInjector("fetch:error@2,source.nan@2")
+    inj.perturb("fetch")
+    with pytest.raises(InjectedFault):
+        inj.perturb("fetch")
+    assert inj.should("source.nan") is None
+    assert inj.should("source.nan") is not None
+
+
+def test_poison_labels_touches_only_valid_rows():
+    faults.install_chaos("source.nan@1")
+    from twtml_tpu.features.featurizer import Featurizer
+
+    statuses = list(
+        SyntheticSource(total=5, seed=1, base_ms=1785320000000).produce()
+    )
+    batch = Featurizer(now_ms=1785320000000).featurize_batch_units(
+        statuses, row_bucket=8, unit_bucket=64, pre_filtered=True
+    )
+    poisoned = faults.maybe_poison_labels(batch)
+    valid = np.asarray(batch.mask) > 0
+    assert np.isnan(poisoned.label[valid]).all()
+    # padding labels stay zero: the learner multiplies by mask, and NaN
+    # padding would taint every batch
+    assert (poisoned.label[~valid] == 0).all()
+    assert not np.isnan(np.asarray(batch.label)).any()  # input untouched
+
+
+def test_corrupt_block_skips_tiny_buffers():
+    faults.install_chaos("source.garbage@1")
+    tiny = b'{"x": 1}\n'
+    assert faults.maybe_corrupt_block(tiny) == tiny  # under the 256B floor
+    big = b"x" * 1024
+    out = faults.maybe_corrupt_block(big)
+    assert len(out) < len(big)
+    assert out != big[: len(out)]  # garbled, not just truncated
 
 
 def test_bad_chaos_flag_is_a_loud_exit():

@@ -1,0 +1,198 @@
+"""Verified checkpoints (ISSUE 4 tentpole, part 3): per-array CRC32 + a
+finite flag in the archive meta. save() quarantines non-finite weights
+instead of rotating good history out of keep_last; restore() verifies
+checksums/shape/dtype and falls back past corrupt or non-finite archives
+with distinct warnings; malformed ckpt-* filenames never crash the step
+parse (satellite regression)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from twtml_tpu.checkpoint import Checkpointer
+from twtml_tpu.telemetry import metrics as _metrics
+
+
+@pytest.fixture(autouse=True)
+def clean_metrics():
+    _metrics.reset_for_tests()
+    yield
+    _metrics.reset_for_tests()
+
+
+def _weights(seed, shape=(32,)):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+# -- satellite: malformed filenames ------------------------------------------
+
+def test_malformed_ckpt_names_are_tolerated(tmp_path):
+    """Regression: a stray name matching the ckpt- prefix used to crash
+    latest_step's int(...) parse."""
+    ck = Checkpointer(str(tmp_path))
+    ck.save(3, _weights(0))
+    for stray in ("ckpt-backup.npz", "ckpt-.npz", "ckpt-12a4.npz",
+                  "ckpt-000000000003.npz.orig", "ckpt-old-000000000002.npz"):
+        (tmp_path / stray).write_bytes(b"not a checkpoint")
+    assert ck.latest_step() == 3
+    state, meta = ck.restore()
+    np.testing.assert_array_equal(state, _weights(0))
+    # and pruning ignores them too
+    for step in (4, 5, 6, 7):
+        ck.save(step, _weights(step))
+    assert ck.latest_step() == 7
+    assert (tmp_path / "ckpt-backup.npz").exists()
+
+
+# -- CRC / shape / dtype verification ----------------------------------------
+
+def _tamper(path, mutate):
+    """Rewrite an archive with its arrays mutated but its META unchanged —
+    the torn/bit-flipped-but-still-loadable case CRC exists for."""
+    with np.load(path) as data:
+        arrays = {k: data[k] for k in data.files}
+    arrays = mutate(arrays)
+    with open(path, "wb") as fh:
+        np.savez(fh, **arrays)
+
+
+def test_crc_mismatch_falls_back_to_older(tmp_path):
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, _weights(1))
+    ck.save(2, _weights(2))
+
+    def flip(arrays):
+        w = arrays["w"].copy()
+        w[5] += 1.0  # silent bit damage: still np.loads fine
+        arrays["w"] = w
+        return arrays
+
+    _tamper(str(tmp_path / "ckpt-000000000002.npz"), flip)
+    state, meta = ck.restore()
+    assert meta["step"] == 1
+    np.testing.assert_array_equal(state, _weights(1))
+    assert _metrics.get_registry().counter(
+        "checkpoint.restore_corrupt").snapshot() == 1
+
+
+def test_shape_and_dtype_mismatch_fall_back(tmp_path):
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, _weights(1))
+    ck.save(2, _weights(2))
+    ck.save(3, _weights(3))
+    _tamper(
+        str(tmp_path / "ckpt-000000000003.npz"),
+        lambda a: {**a, "w": a["w"][:16]},  # truncated write
+    )
+    _tamper(
+        str(tmp_path / "ckpt-000000000002.npz"),
+        lambda a: {**a, "w": a["w"].astype(np.float64)},
+    )
+    state, meta = ck.restore()
+    assert meta["step"] == 1
+    assert _metrics.get_registry().counter(
+        "checkpoint.restore_corrupt").snapshot() == 2
+
+
+def test_dict_state_verifies_per_array(tmp_path):
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, {"centers": _weights(1, (4, 8)), "counts": np.arange(4)})
+    ck.save(2, {"centers": _weights(2, (4, 8)), "counts": np.arange(4)})
+
+    def corrupt_one(arrays):
+        c = arrays["w__centers"].copy()
+        c[0, 0] = 999.0
+        arrays["w__centers"] = c
+        return arrays
+
+    _tamper(str(tmp_path / "ckpt-000000000002.npz"), corrupt_one)
+    state, meta = ck.restore()
+    assert meta["step"] == 1
+    np.testing.assert_array_equal(state["centers"], _weights(1, (4, 8)))
+
+
+def test_missing_key_is_corrupt(tmp_path):
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, {"a": _weights(1), "b": _weights(2)})
+    ck.save(2, {"a": _weights(3), "b": _weights(4)})
+    _tamper(
+        str(tmp_path / "ckpt-000000000002.npz"),
+        lambda arrays: {k: v for k, v in arrays.items() if k != "w__b"},
+    )
+    state, meta = ck.restore()
+    assert meta["step"] == 1
+
+
+# -- non-finite quarantine ---------------------------------------------------
+
+def test_nonfinite_save_quarantines_instead_of_overwriting(tmp_path):
+    """THE keep_last poisoning scenario: a diverged model checkpointing on
+    cadence would rotate every good archive out within N saves. Non-finite
+    saves go to quarantine-* names restore never sees."""
+    ck = Checkpointer(str(tmp_path), keep_last=3)
+    ck.save(1, _weights(1))
+    bad = _weights(9)
+    bad[3] = np.nan
+    for step in (2, 3, 4, 5):  # would have rotated step 1 out twice over
+        path = ck.save(step, bad)
+        assert os.path.basename(path).startswith("quarantine-")
+    state, meta = ck.restore()
+    assert meta["step"] == 1
+    np.testing.assert_array_equal(state, _weights(1))
+    assert ck.latest_step() == 1
+    reg = _metrics.get_registry()
+    assert reg.counter("checkpoint.quarantined").snapshot() == 4
+    # the quarantined archives are preserved for postmortems
+    assert len([n for n in os.listdir(tmp_path)
+                if n.startswith("quarantine-")]) == 4
+
+
+def test_inf_counts_as_nonfinite_and_int_arrays_are_fine(tmp_path):
+    ck = Checkpointer(str(tmp_path))
+    bad = _weights(1)
+    bad[0] = np.inf
+    assert os.path.basename(ck.save(1, bad)).startswith("quarantine-")
+    # integer state is trivially finite
+    path = ck.save(2, {"counts": np.arange(5, dtype=np.int64)})
+    assert os.path.basename(path) == "ckpt-000000000002.npz"
+
+
+def test_restore_skips_legacy_nonfinite_archives(tmp_path):
+    """Archives written BEFORE the integrity meta existed: finiteness is
+    recomputed at restore, so a pre-r7 diverged save is still skipped."""
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, _weights(1))
+    # hand-write a legacy-format archive (no finite/arrays meta) with NaNs
+    bad = _weights(2)
+    bad[0] = np.nan
+    meta = {"step": 2}
+    with open(tmp_path / "ckpt-000000000002.npz", "wb") as fh:
+        np.savez(fh, __meta__=np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8), w=bad)
+    state, meta = ck.restore()
+    assert meta["step"] == 1
+    assert _metrics.get_registry().counter(
+        "checkpoint.restore_nonfinite").snapshot() == 1
+
+
+def test_legacy_finite_archive_still_restores(tmp_path):
+    """Back-compat: pre-r7 archives carry no CRC meta and must restore."""
+    w = _weights(4)
+    meta = {"step": 9, "count": 123}
+    with open(tmp_path / "ckpt-000000000009.npz", "wb") as fh:
+        np.savez(fh, __meta__=np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8), w=w)
+    state, got = Checkpointer(str(tmp_path)).restore()
+    np.testing.assert_array_equal(state, w)
+    assert got["count"] == 123
+
+
+def test_unreadable_archive_still_falls_back(tmp_path):
+    """The pre-r7 behavior (crash-during-write tolerance) is preserved."""
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, _weights(1))
+    (tmp_path / "ckpt-000000000002.npz").write_bytes(b"torn write")
+    state, meta = ck.restore()
+    assert meta["step"] == 1

@@ -147,6 +147,30 @@ def test_metrics_frame_updates_observability_panel():
     assert "degraded" not in h.el("tunnelPhase").class_set
 
 
+def test_metrics_frame_updates_ingest_guard_tiles():
+    """r7 ingest/state robustness tiles: queue depth (rows), shed rows,
+    and sentinel rollbacks (highlighted once any occurred)."""
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="Metrics",
+        counters={"ingest.rows_shed": 4096, "model.rollbacks": 2},
+        gauges={"ingest.queue_rows": 12288},
+        health={"phase": "healthy", "rtt_ms": 70.0, "transitions": 0},
+    ))
+    assert h.el("queueRows").text == "12288"
+    assert h.el("rowsShed").text == "4096"
+    assert h.el("rollbacks").text == "2"
+    assert "degraded" in h.el("rollbacks").class_set
+    # a healthy run keeps the tile quiet
+    h.ws.server_message(frame(
+        jsonClass="Metrics", counters={}, gauges={},
+        health={"phase": "healthy", "rtt_ms": 70.0, "transitions": 0},
+    ))
+    assert h.el("rollbacks").text == "0"
+    assert "degraded" not in h.el("rollbacks").class_set
+
+
 def test_metrics_backfill_fetched_on_boot():
     h = dashboard()
     urls = [u for u, _ in h.fetches]

@@ -403,6 +403,82 @@ def test_two_process_2d_mesh_gram_inner_loop():
     np.testing.assert_allclose(outs[0]["weights"], weights, rtol=1e-4, atol=1e-6)
 
 
+def test_app_level_multihost_sentinel_rollback(tmp_path):
+    """r7 (ISSUE 4): the divergence sentinel on a REAL two-process group.
+    Each host's --chaos source.nan@2 poisons its local rows of the SAME
+    global batch (per-host injectors, identical tick counters), both hosts
+    see the same non-finite psum stats at the same deterministic delivery,
+    and both roll back the same step: the lead restores its verified
+    checkpoint from disk and BROADCASTS it (the follower has no checkpoint
+    files), the rollback count rides the cadence allgather with no
+    disagreement abort, the poisoned batch is skipped, and the run
+    completes cleanly."""
+    import json as _json
+
+    from tools.bench_suite import _status_json
+    from twtml_tpu.streaming.sources import SyntheticSource
+
+    path = tmp_path / "tweets.jsonl"
+    statuses = list(
+        SyntheticSource(total=96, seed=33, base_ms=1785320000000).produce()
+    )
+    with open(path, "w") as fh:
+        for s in statuses:
+            fh.write(_json.dumps(_status_json(s)) + "\n")
+
+    closed = "http://127.0.0.1:9"
+    d_ck = str(tmp_path / "ck")
+    port = _free_port()
+    env = dict(os.environ, PYTHONPATH=REPO)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, APP_WORKER, str(i), "2", str(port), "2",
+             "linear", "--source", "replay", "--replayFile", str(path),
+             "--seconds", "0", "--backend", "cpu",
+             "--batchBucket", "16", "--tokenBucket", "64",
+             "--checkpointDir", d_ck, "--checkpointEvery", "1",
+             "--chaos", "source.nan@2",
+             "--lightning", closed, "--twtweb", closed],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs, errs = [], []
+    try:
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=300.0)
+            if p.returncode != 0:
+                pytest.fail(
+                    f"worker failed rc={p.returncode}:\n{stderr[-3000:]}"
+                )
+            outs.append(stdout)
+            errs.append(stderr)
+    finally:
+        for p in procs:
+            p.kill()
+
+    # BOTH hosts rolled back (lead from disk, follower via the broadcast)
+    # — and the allgather-ridden counts never disagreed
+    for err in errs:
+        assert "rolled back to verified checkpoint" in err, err[-2000:]
+        assert "disagree on sentinel rollback counts" not in err
+
+    lead = [ln for ln in outs[0].splitlines() if ln.startswith("count:")]
+    follower = [ln for ln in outs[1].splitlines() if ln.startswith("count:")]
+    assert follower == []  # one telemetry owner per run
+    # 3 global batches of 32; the poisoned 2nd is skipped, not counted
+    assert len(lead) == 2
+    assert "count: 64" in lead[-1]
+
+    from twtml_tpu.checkpoint import Checkpointer
+
+    state, meta = Checkpointer(d_ck).restore()
+    assert meta["count"] == 64
+    assert meta["batches"] == 2
+    assert np.isfinite(np.asarray(state)).all()
+
+
 def test_lockstep_abort_propagates_instead_of_hanging():
     """A batch failure on one host aborts the GROUP: the failing host
     broadcasts abort on its next tick, the healthy peer stops instead of

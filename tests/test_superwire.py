@@ -179,6 +179,10 @@ def test_superbatcher_group_mode_matches_stacked_end_to_end():
             lambda o, b, t, at_boundary: seen.append(
                 (float(o.count), float(o.mse), at_boundary)
             ),
+            # counter-driven emit points: at_boundary at a non-final group
+            # otherwise races the already-done early-emit probe, and the
+            # two arms can draw different winners
+            deterministic=True,
             wire_pack=mode,
         )
         for i, b in enumerate(batches):

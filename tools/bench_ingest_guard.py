@@ -1,0 +1,116 @@
+"""Healthy-path neutrality of the r7 ingest/state guards (ISSUE 4
+acceptance): the divergence sentinel (three host isfinite checks per
+delivered batch) and the bounded intake queue (one int compare per put)
+must cost nothing measurable when nothing is wrong.
+
+Arms (the house interleaved/paired method, tools/pairedbench.py — each
+pass is ONE full flagship-app replay run, end to end: source thread,
+bounded queue, featurize, FetchPipeline, sentinel gate, checkpoint-free
+handler):
+
+- guards_off : --sentinel off --maxQueueRows -1 (the pre-r7 pipeline);
+- guards_on  : the shipped defaults (sentinel on, auto queue bound).
+
+The verdict is the median paired off/on ratio; >= 0.98 means the guard
+layer ships free. CPU control only unless a TPU is attached — the guards
+are pure host work, so the CPU control is the binding measurement.
+
+Usage: python tools/bench_ingest_guard.py [--tweets N] [--batch B]
+          [--budget S]
+Prints one JSON line (BENCHMARKS.md "Ingest guards" records the result).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    n_tweets, batch, budget = 32768, 2048, 120.0
+    i = 0
+    while i < len(args):
+        if args[i] == "--tweets":
+            n_tweets = int(args[i + 1]); i += 2
+        elif args[i] == "--batch":
+            batch = int(args[i + 1]); i += 2
+        elif args[i] == "--budget":
+            budget = float(args[i + 1]); i += 2
+        else:
+            raise SystemExit(f"unknown flag {args[i]!r}")
+
+    import tempfile
+
+    import jax
+
+    from tools.bench_suite import _status_json
+    from tools.pairedbench import (
+        best_median_rate, paired_ratio_median, run_rounds,
+    )
+    from twtml_tpu.apps import linear_regression as app
+    from twtml_tpu.config import ConfArguments
+    from twtml_tpu.streaming.sources import SyntheticSource
+
+    tmp = tempfile.mkdtemp(prefix="bench-guard-")
+    replay = os.path.join(tmp, "tweets.jsonl")
+    with open(replay, "w") as fh:
+        for s in SyntheticSource(
+            total=n_tweets, seed=5, base_ms=1785320000000
+        ).produce():
+            fh.write(json.dumps(_status_json(s)) + "\n")
+
+    closed = "http://127.0.0.1:9"  # closed port: telemetry Try paths
+    base = [
+        "--source", "replay", "--replayFile", replay,
+        "--seconds", "0", "--batchBucket", str(batch),
+        "--tokenBucket", "512",
+        "--lightning", closed, "--twtweb", closed, "--webTimeout", "0.5",
+    ]
+
+    def run_app(extra):
+        t0 = time.perf_counter()
+        totals = app.run(ConfArguments().parse(base + extra))
+        dt = time.perf_counter() - t0
+        assert totals["count"] == n_tweets, totals
+        return dt
+
+    # one warm pass per arm (program compiles; both arms share programs)
+    run_app(["--sentinel", "off", "--maxQueueRows", "-1"])
+    run_app([])
+
+    times = run_rounds({
+        "guards_off": lambda: run_app(
+            ["--sentinel", "off", "--maxQueueRows", "-1"]
+        ),
+        "guards_on": lambda: run_app([]),
+    }, budget)
+
+    out = {
+        "regime": "ingest-guard-neutrality",
+        "tweets": n_tweets, "batch": batch,
+        "backend": jax.default_backend(),
+        "rounds": len(times["guards_on"]),
+    }
+    for name, ts in times.items():
+        best, median = best_median_rate(ts, n_tweets)
+        out[name] = {
+            "tweets_per_sec_best": best,
+            "tweets_per_sec_median": median,
+        }
+    out["guards_on"]["paired_vs_off"] = paired_ratio_median(
+        times["guards_off"], times["guards_on"]
+    )
+    out["neutral"] = out["guards_on"]["paired_vs_off"] >= 0.98
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
+
+

@@ -12,7 +12,18 @@ closed port, so un-dropped publishes also fail fast). The run must
 SURVIVE: every round trains the full corpus, counters prove the guards
 fired (retries > 0, breaker failures > 0), and zero fetch aborts occur.
 
+r7 adds a SOURCE-chaos phase (--sourcePhase, on by default: the budget
+splits between the two phases): block-ingest rounds under source.garbage
+(corrupt wire bytes the parser must skip-and-count), source.burst (rate
+spikes into the bounded intake queue), and source.nan (poisoned labels →
+the divergence sentinel's rollback-to-verified-checkpoint path). The
+contract is survive-and-recover: every round completes, rollbacks fire
+and RECOVER (no sentinel abort, no fetch abort), all three rules fire,
+and row losses show up in counters (rows_lost / rows_dropped_parse /
+rows_shed) — never silently.
+
 Usage: python tools/chaos_soak.py [--minutes M] [--tweets N] [--chaos SPEC]
+          [--sourceChaos SPEC] [--sourcePhase on|off]
 Prints one JSON line at the end; exits non-zero on any violated invariant.
 """
 
@@ -37,10 +48,20 @@ DEFAULT_CHAOS = (
     "web:error@p0.8,seed=3"
 )
 
+# source-phase defaults: one poisoned batch per round (16384/2048 = 8
+# batches; @6 lands mid-round after several verified checkpoint saves), a
+# corrupted parse chunk, and a block-duplication burst into the bounded
+# queue. All three are survivable by design: the sentinel rolls back and
+# continues, the parser skips and counts, the queue blocks the producer.
+DEFAULT_SOURCE_CHAOS = (
+    "source.nan@6,source.garbage@4,source.burst:rows=1@5,seed=3"
+)
+
 
 def main(argv=None) -> None:
     args = list(sys.argv[1:] if argv is None else argv)
     minutes, n_tweets, chaos = 10.0, 16384, DEFAULT_CHAOS
+    source_chaos, source_phase = DEFAULT_SOURCE_CHAOS, True
     i = 0
     while i < len(args):
         if args[i] == "--minutes":
@@ -49,6 +70,10 @@ def main(argv=None) -> None:
             n_tweets = int(args[i + 1]); i += 2
         elif args[i] == "--chaos":
             chaos = args[i + 1]; i += 2
+        elif args[i] == "--sourceChaos":
+            source_chaos = args[i + 1]; i += 2
+        elif args[i] == "--sourcePhase":
+            source_phase = args[i + 1] == "on"; i += 2
         else:
             raise SystemExit(f"unknown flag {args[i]!r}")
 
@@ -76,7 +101,8 @@ def main(argv=None) -> None:
         "--chaos", chaos,
     ]
 
-    deadline = time.time() + minutes * 60.0
+    transport_s = minutes * 60.0 * (0.5 if source_phase else 1.0)
+    deadline = time.time() + transport_s
     rounds, tweets, failures = 0, 0, []
     t0 = time.time()
     while time.time() < deadline:
@@ -90,6 +116,61 @@ def main(argv=None) -> None:
             )
             break
         tweets = totals["count"]
+
+    # -- source-chaos phase (r7): block ingest + garbage/burst/nan -------
+    from twtml_tpu.streaming import faults as _faults
+
+    src_rounds, src_rollbacks = 0, 0
+    if source_phase and not failures:
+        _faults.uninstall_chaos()
+        src_args = [
+            "--source", "replay", "--replayFile", replay,
+            "--ingest", "block",
+            "--seconds", "0", "--batchBucket", "2048",
+            "--tokenBucket", "512",
+            "--maxQueueRows", str(4 * 2048),
+            "--checkpointDir", os.path.join(tmp, "ck-src"),
+            "--checkpointEvery", "2",
+            "--lightning", closed, "--twtweb", closed,
+            "--webTimeout", "0.5",
+            "--chaos", source_chaos,
+        ]
+        deadline = time.time() + minutes * 60.0 * 0.5
+        reg0 = _metrics.get_registry()
+        count0 = 0
+        while time.time() < deadline:
+            try:
+                totals = app.run(ConfArguments().parse(list(src_args)))
+            except RuntimeError as exc:
+                failures.append(
+                    f"source-chaos round {src_rounds + 1} aborted: {exc}"
+                )
+                break
+            src_rounds += 1
+            if totals["count"] - count0 <= 0:
+                failures.append(
+                    f"source-chaos round {src_rounds} made no progress"
+                )
+                break
+            count0 = totals["count"]
+        snap = reg0.snapshot()["counters"]
+        src_rollbacks = snap.get("model.rollbacks", 0)
+        if src_rounds:
+            if not src_rollbacks:
+                failures.append("source.nan never drove a sentinel rollback")
+            if snap.get("model.sentinel_aborts", 0):
+                failures.append("sentinel aborted under survivable chaos")
+            for rule in ("source.nan", "source.garbage", "source.burst"):
+                if not snap.get(f"chaos.{rule}.injected", 0):
+                    failures.append(f"{rule} never fired")
+            # losses must be ACCOUNTED, never silent: every poisoned batch
+            # shows in rows_lost, every garbled line in rows_dropped_parse
+            if not snap.get("model.rows_lost", 0):
+                failures.append("rollbacks fired but model.rows_lost is 0")
+            if not snap.get("ingest.rows_dropped_parse", 0):
+                failures.append(
+                    "garbage fired but ingest.rows_dropped_parse is 0"
+                )
 
     reg = _metrics.get_registry().snapshot()
     counters = reg["counters"]
@@ -114,6 +195,12 @@ def main(argv=None) -> None:
         "minutes": round((time.time() - t0) / 60.0, 2),
         "rounds": rounds,
         "tweets": tweets,
+        "source_rounds": src_rounds,
+        "source_chaos": source_chaos if source_phase else "",
+        "sentinel_rollbacks": src_rollbacks,
+        "rows_lost": counters.get("model.rows_lost", 0),
+        "rows_dropped_parse": counters.get("ingest.rows_dropped_parse", 0),
+        "rows_shed": counters.get("ingest.rows_shed", 0),
         "chaos": chaos,
         "chaos_injected": injected,
         "fetch_retries": retries,

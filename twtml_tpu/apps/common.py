@@ -372,6 +372,7 @@ class AppCheckpoint:
                  lead: bool = True):
         self._ckpt = None
         self._get_state = get_state
+        self._set_state = set_state
         self._lead = lead
         self.every = int(getattr(conf, "checkpointEvery", 0) or 0)
         if not conf.checkpointDir:
@@ -447,6 +448,221 @@ class AppCheckpoint:
             return False
         self._save(totals)
         return True
+
+    def rollback_to_verified(self) -> "dict | None":
+        """Restore the newest VERIFIED (checksummed, finite) checkpoint
+        into the model — the divergence sentinel's recovery hook. Returns
+        the checkpoint meta, or None when no verified checkpoint exists
+        (checkpoints off, empty dir, or every archive corrupt/non-finite).
+
+        Multi-host: lead-authoritative like the startup restore — the lead
+        restores from disk and its state broadcasts to every process (a
+        follower has no checkpoint files). All hosts MUST call this on the
+        same tick (the sentinel guarantees it: stats are psum-global and
+        deliveries deterministic, verified by the rollback count riding
+        the cadence allgather), because the broadcast is a collective."""
+        restored = (
+            self._ckpt.restore() if self._ckpt is not None else None
+        )
+        import jax
+
+        if jax.process_count() <= 1:
+            if restored is None:
+                return None
+            state, meta = restored
+            self._set_state(state)
+            return meta
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        ok = int(restored is not None) if self._lead else 0
+        # EVERY host fetches its current state first: the broadcast needs a
+        # structurally identical pytree per process, and get_state itself
+        # may be a collective (MultiHostSGDModel.latest_weights allgathers)
+        # — the lead must participate too, then its disk state wins
+        state = self._get_state()
+        if self._lead and restored is not None:
+            state = restored[0]
+        flag, state = multihost_utils.broadcast_one_to_all((
+            np.array([ok], np.int64), state,
+        ))
+        if not int(flag[0]):
+            return None
+        self._set_state(jax.tree_util.tree_map(np.asarray, state))
+        if self._lead and restored is not None:
+            return restored[1]
+        return {"broadcast": True}
+
+
+class DivergenceSentinel:
+    """Non-finite-state guard at the model boundary (``--sentinel``, default
+    on): one poisoned batch (NaN/Inf labels, adversarial features) drives
+    the fused predict-then-train step's weights non-finite in a single
+    update, and — before this guard — silently destroyed the model AND,
+    within ``keep_last`` cadence saves, every checkpoint the resume path
+    relies on.
+
+    **Zero added host fetches** (the r2/r3 measurement law — asserted by
+    tests the way the ``--trace`` tests are): the finiteness check reads
+    ONLY the StepOutput scalars the pipeline already fetched per batch
+    (mse/stdevs — NaN labels or NaN weights propagate into all of them
+    through the on-device stats reduction). Healthy-path cost is three
+    ``math.isfinite`` calls per batch (paired-neutral on the CPU control,
+    BENCHMARKS.md).
+
+    On a non-finite delivery: the batch is SKIPPED (never handed to the
+    app handler — its stats are garbage; the dispatch slot is refunded so
+    max-batches caps don't under-train), the model rolls back to the last
+    VERIFIED-finite checkpoint (``AppCheckpoint.rollback_to_verified``;
+    without ``--checkpointDir`` it resets to the reference's initial
+    zeros), and ``model.rollbacks`` counts it. Consecutive non-finite
+    deliveries are ONE episode — batches already dispatched against the
+    poisoned weights drain through as tainted skips without re-rolling
+    back — and the first finite delivery closes it. After
+    ``--sentinelRollbacks`` rollbacks within ``--sentinelWindow`` batches
+    the run aborts CLEANLY via the existing ``ssc.request_abort`` path
+    (checkpointed shutdown, non-zero exit): a stream that keeps poisoning
+    the model is an operator problem, not a retry problem.
+
+    PARITY: on the healthy path the sentinel observes and never touches
+    reference semantics; it only ever SKIPS batches whose state is
+    non-finite — a regime where the reference would train garbage forever
+    (PARITY.md).
+
+    Multi-host: stats are psum-global and deliveries deterministic, so
+    every host reaches the same verdict at the same delivered batch and
+    performs the same rollback (the checkpoint broadcast inside
+    ``rollback_to_verified`` is collective). The cumulative rollback count
+    rides the per-tick cadence allgather (``ssc.rollback_count_fn``) so
+    the group VERIFIES it rolled back the same steps instead of assuming
+    it."""
+
+    def __init__(self, conf, model, ckpt: AppCheckpoint, ssc,
+                 lead: bool = True):
+        self.enabled = getattr(conf, "sentinel", "on") == "on"
+        self.max_rollbacks = int(getattr(conf, "sentinelRollbacks", 3) or 0)
+        self.window = max(1, int(getattr(conf, "sentinelWindow", 512) or 1))
+        self._model = model
+        self._ckpt = ckpt
+        self._ssc = ssc
+        self._lead = lead
+        self._num_features = int(getattr(conf, "numTextFeatures", 1000))
+        self._tainted = False
+        self._delivered = 0
+        self._rollback_points: list[int] = []
+        self._pipeline = None
+        reg = _metrics.get_registry()
+        self._nonfinite_count = reg.counter("model.nonfinite_batches")
+        self._rollback_count = reg.counter("model.rollbacks")
+        self._rows_lost = reg.counter("model.rows_lost")
+        if self.enabled:
+            # the rollback count rides the lockstep cadence allgather so
+            # multi-host groups verify they rolled back the same steps
+            ssc.rollback_count_fn = lambda: len(self._rollback_points)
+
+    def bind(self, pipeline) -> None:
+        """Attach the fetch pipeline/batcher whose ``refund_dispatch``
+        keeps max-batches caps exact when a batch is skipped."""
+        self._pipeline = pipeline
+
+    @property
+    def rollbacks(self) -> int:
+        return len(self._rollback_points)
+
+    @staticmethod
+    def _finite(out) -> bool:
+        import math
+
+        # the already-fetched per-batch scalars: NaN labels hit mse and
+        # real_stdev immediately; NaN WEIGHTS (a poisoned prior batch) hit
+        # pred_stdev/mse through the predictions — between them every
+        # non-finite state the fused step can reach is visible without
+        # touching the device
+        return (
+            math.isfinite(float(out.mse))
+            and math.isfinite(float(out.real_stdev))
+            and math.isfinite(float(out.pred_stdev))
+        )
+
+    def admit(self, out, batch) -> bool:
+        """Per-delivery gate (wired by ``attach_super_batcher``): True →
+        hand the batch to the app handler; False → skipped (non-finite
+        state; rollback/abort already handled here)."""
+        self._delivered += 1
+        if self._finite(out):
+            if self._tainted:
+                log.warning(
+                    "divergence sentinel: finite stats resumed at "
+                    "delivered batch %d — rollback recovered the model",
+                    self._delivered,
+                )
+                self._tainted = False
+            return True
+        self._nonfinite_count.inc()
+        rows = int(out.count) if hasattr(out, "count") else 0
+        self._rows_lost.inc(rows)
+        if self._pipeline is not None:
+            self._pipeline.refund_dispatch()
+        if self._tainted:
+            # same episode: a batch dispatched against the poisoned
+            # weights before the rollback took effect drains through
+            log.warning(
+                "divergence sentinel: skipping tainted in-flight batch "
+                "(delivered %d, %d rows)", self._delivered, rows,
+            )
+            return False
+        self._tainted = True
+        self._rollback()
+        return False
+
+    def _rollback(self) -> None:
+        self._rollback_count.inc()
+        self._rollback_points.append(self._delivered)
+        _trace.get().instant(
+            "sentinel_rollback", delivered=self._delivered,
+            episode=len(self._rollback_points),
+        )
+        meta = self._ckpt.rollback_to_verified()
+        if meta is not None:
+            log.error(
+                "divergence sentinel: NON-FINITE model state at delivered "
+                "batch %d — rolled back to verified checkpoint step %s and "
+                "skipping the poisoning batch (rollback #%d)",
+                self._delivered, meta.get("step", "?"),
+                len(self._rollback_points),
+            )
+        else:
+            # nothing to roll back to: reset to the reference's initial
+            # state (zeros, LinearRegression.scala:32) — progress is lost
+            # but the stream keeps training, which beats NaN forever
+            import numpy as np
+
+            from ..features.batch import NUM_NUMBER_FEATURES
+
+            self._model.set_initial_weights(np.zeros(
+                (self._num_features + NUM_NUMBER_FEATURES,), np.float32,
+            ))
+            log.error(
+                "divergence sentinel: NON-FINITE model state at delivered "
+                "batch %d and no verified checkpoint — model RESET to "
+                "initial zeros (rollback #%d); add --checkpointDir to "
+                "preserve progress across rollbacks",
+                self._delivered, len(self._rollback_points),
+            )
+        in_window = [
+            p for p in self._rollback_points
+            if self._delivered - p < self.window
+        ]
+        if self.max_rollbacks and len(in_window) >= self.max_rollbacks:
+            _metrics.get_registry().counter("model.sentinel_aborts").inc()
+            log.critical(
+                "divergence sentinel: %d rollbacks within %d batches — the "
+                "stream keeps poisoning the model; aborting the run "
+                "cleanly (the shutdown path flushes a final checkpoint "
+                "and the process exits non-zero)",
+                len(in_window), self.window,
+            )
+            self._ssc.request_abort()
 
 
 class ProcessRecycler:
@@ -1241,7 +1457,7 @@ class FetchPipeline:
 
 
 def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
-                         max_dispatch: int = 0, abort=None):
+                         max_dispatch: int = 0, abort=None, sentinel=None):
     """Wire the app's per-batch ``handle(out, batch, t, at_boundary)`` to the
     stream: plain step-then-handle by default, grouped through a
     SuperBatcher when ``--superBatch K`` applies. Returns
@@ -1281,6 +1497,18 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
     def handle(out, batch, t, at_boundary=True):  # noqa: F811
         watchdog.tick()
         guarded_handle(out, batch, t, at_boundary=at_boundary)
+
+    if sentinel is not None and sentinel.enabled:
+        # divergence gate between the fetch and the app handler: a
+        # non-finite delivery is skipped (rollback handled inside admit);
+        # wrapped INSIDE the multi-host empty-batch filter below, so the
+        # gate only ever sees batches with rows
+        sentinel_inner = handle
+
+        def handle(out, batch, t, at_boundary=True):  # noqa: F811
+            if not sentinel.admit(out, batch):
+                return
+            sentinel_inner(out, batch, t, at_boundary=at_boundary)
 
     multihost = jax.process_count() > 1
     k = int(getattr(conf, "superBatch", 1) or 1)
@@ -1377,6 +1605,8 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
             )
             if multihost:
                 pipeline_ref.append(pipe)  # empty-batch refunds (above)
+            if sentinel is not None:
+                sentinel.bind(pipe)  # skipped batches refund their cap slot
             stream.foreach_batch(skip_empty(pipe.on_batch))
             return pipe.flush, 1
 
@@ -1442,6 +1672,8 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
     )
     if multihost:
         pipeline_ref.append(batcher)  # empty-batch refunds (above)
+    if sentinel is not None:
+        sentinel.bind(batcher)  # skipped batches refund their cap slot
     # grouping needs every batch in its FINAL layout before the shape
     # signature/stacking: mesh and multi-host models shard-align ragged
     # batches (and harmonize the wire dtype across hosts) in prepare()

@@ -132,7 +132,14 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
         .set_random_centers(NUM_DIMENSIONS, 0.0)
     )
     scale = jax.jit(standard_scale)
-    ssc = StreamingContext(batch_interval=conf.seconds)
+    ssc = StreamingContext(
+        batch_interval=conf.seconds,
+        # bounded intake backpressure — same guard as the SGD apps; the
+        # k-means stream has no SGD sentinel (its state is decayed
+        # averages, not gradient-updated weights)
+        max_queue_rows=conf.effective_max_queue_rows(),
+        shed_policy=conf.shedPolicy,
+    )
     totals = {"count": 0, "batches": 0}
 
     # checkpoint/resume of the cluster state — same upgrade as the SGD apps

@@ -61,7 +61,13 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     install_chaos(conf)
 
     log.info("Initializing streaming context... %s sec/batch", conf.seconds)
-    ssc = StreamingContext(batch_interval=conf.seconds)
+    ssc = StreamingContext(
+        batch_interval=conf.seconds,
+        # bounded intake backpressure (--maxQueueRows/--shedPolicy):
+        # the queue was the last unbounded buffer in the pipeline
+        max_queue_rows=conf.effective_max_queue_rows(),
+        shed_policy=conf.shedPolicy,
+    )
     stream = ssc.source_stream(
         build_source(conf, allow_block=True), featurizer,
         row_bucket=conf.batchBucket, token_bucket=conf.tokenBucket,
@@ -85,6 +91,13 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     # re-exec) once RSS crosses the ceiling — the actionable form of the
     # RSS watchdog's diagnosis (apps/common.ProcessRecycler)
     recycler = ProcessRecycler(conf, ckpt, totals)
+
+    # divergence sentinel (--sentinel, default on): non-finite per-batch
+    # stats → skip the batch, roll back to the last verified-finite
+    # checkpoint, abort cleanly after N rollbacks (apps/common)
+    from .common import DivergenceSentinel
+
+    sentinel = DivergenceSentinel(conf, model, ckpt, ssc, lead=lead)
 
     from ..utils.tracing import Tracer
 
@@ -126,6 +139,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
             max(1, max_batches - totals["batches"]) if max_batches else 0
         ),
         abort=ssc.request_abort,  # fetch-watchdog aborts fail the run loudly
+        sentinel=sentinel,
     )
 
     warmup_compile(stream, model, super_batch=group_k)
@@ -158,9 +172,9 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         ckpt.final_save(totals)
     if ssc.failed:
         raise RuntimeError(
-            "run aborted by a runtime guard — lockstep peer loss or a fetch "
-            "watchdog abort (see critical log above); progress up to the "
-            "failure is checkpointed"
+            "run aborted by a runtime guard — lockstep peer loss, a fetch "
+            "watchdog abort, or the divergence sentinel (see critical log "
+            "above); progress up to the failure is checkpointed"
         )
     return totals
 

@@ -30,6 +30,7 @@ from ..telemetry.session_stats import SessionStats
 from ..utils import get_logger, round_half_up
 from .common import (
     AppCheckpoint,
+    DivergenceSentinel,
     ProcessRecycler,
     attach_super_batcher,
     build_model,
@@ -62,7 +63,11 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     install_trace(conf)
     install_chaos(conf)
 
-    ssc = StreamingContext(batch_interval=conf.seconds)
+    ssc = StreamingContext(
+        batch_interval=conf.seconds,
+        max_queue_rows=conf.effective_max_queue_rows(),
+        shed_policy=conf.shedPolicy,
+    )
     stream = ssc.source_stream(
         build_source(conf, allow_block=True), featurizer,
         row_bucket=conf.batchBucket, token_bucket=conf.tokenBucket,
@@ -81,6 +86,9 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         lead=lead,
     )
     recycler = ProcessRecycler(conf, ckpt, totals)
+
+    # divergence sentinel — same guard as the flagship app (apps/common)
+    sentinel = DivergenceSentinel(conf, model, ckpt, ssc, lead=lead)
 
     def handle(out, batch, _batch_time, at_boundary=True) -> None:
         b = int(out.count)
@@ -122,6 +130,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
             max(1, max_batches - totals["batches"]) if max_batches else 0
         ),
         abort=ssc.request_abort,  # fetch-watchdog aborts fail the run loudly
+        sentinel=sentinel,
     )
     warmup_compile(stream, model, super_batch=group_k)
     ssc.start(lockstep=lockstep)
@@ -140,9 +149,9 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         ckpt.final_save(totals)
     if ssc.failed:
         raise RuntimeError(
-            "run aborted by a runtime guard — lockstep peer loss or a fetch "
-            "watchdog abort (see critical log above); progress up to the "
-            "failure is checkpointed"
+            "run aborted by a runtime guard — lockstep peer loss, a fetch "
+            "watchdog abort, or the divergence sentinel (see critical log "
+            "above); progress up to the failure is checkpointed"
         )
     return totals
 

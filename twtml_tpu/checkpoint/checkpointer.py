@@ -11,6 +11,22 @@ Format: one .npz per checkpoint (atomic rename), flat key namespace for the
 weight pytree, JSON sidecar metadata inside the archive. keep_last bounds
 disk use. Works for single-device and mesh-sharded states (arrays are pulled
 to host; on restore the model re-shards via its own set_initial_weights).
+
+Integrity (r7): the checkpoint is the divergence sentinel's rollback target
+(apps/common.DivergenceSentinel), so it must be trustworthy on two axes the
+atomic rename alone cannot give:
+
+- **Corruption**: each array's CRC32 (+ dtype/shape) is recorded in the
+  meta; ``restore`` re-hashes and falls back past any archive whose bytes
+  no longer match — a torn or bit-flipped file that still ``np.load``s
+  would otherwise restore garbage weights silently.
+- **Finiteness**: the meta records whether every float array was finite at
+  save time. ``save`` refuses to let non-finite weights overwrite good
+  history (within ``keep_last`` saves a diverged model would poison every
+  checkpoint): they are quarantined to a ``quarantine-*`` name instead,
+  preserved for postmortems but invisible to ``restore``. ``restore``
+  additionally skips any (legacy) archive holding non-finite weights, so a
+  rollback always lands on a verified-finite state.
 """
 
 from __future__ import annotations
@@ -18,13 +34,33 @@ from __future__ import annotations
 import io
 import json
 import os
+import re
 import tempfile
+import zlib
 
 import numpy as np
 
 from ..utils import get_logger
 
 log = get_logger("checkpoint")
+
+# finished checkpoints only: a stray name sharing the prefix (editor
+# backup, partial copy) must never crash the int(...) step parse
+_CKPT_RE = re.compile(r"^ckpt-(\d+)\.npz$")
+
+
+def _array_crc(a: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(a).tobytes())
+
+
+def _is_finite(a: np.ndarray) -> bool:
+    """Whether an array holds only finite values; non-float dtypes are
+    trivially finite (isfinite rejects them)."""
+    if not np.issubdtype(a.dtype, np.floating) and not np.issubdtype(
+        a.dtype, np.complexfloating
+    ):
+        return True
+    return bool(np.isfinite(a).all())
 
 
 class Checkpointer:
@@ -55,21 +91,47 @@ class Checkpointer:
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"ckpt-{step:012d}.npz")
 
-    def save(self, step: int, weights, metadata: dict | None = None) -> str:
-        """Atomically write weights (array or flat dict of arrays) + metadata
-        at the given step; prunes old checkpoints beyond keep_last."""
+    @staticmethod
+    def _as_arrays(weights) -> "dict[str, np.ndarray]":
         arrays: dict[str, np.ndarray] = {}
         if isinstance(weights, dict):
             for key, value in weights.items():
                 arrays[f"w__{key}"] = np.asarray(value)
         else:
             arrays["w"] = np.asarray(weights)
+        return arrays
+
+    def save(self, step: int, weights, metadata: dict | None = None) -> str:
+        """Atomically write weights (array or flat dict of arrays) + metadata
+        at the given step; prunes old checkpoints beyond keep_last.
+
+        The meta records per-array CRC32/dtype/shape and a ``finite`` flag.
+        NON-FINITE weights never overwrite good history: they are written
+        under a ``quarantine-`` name ``restore`` ignores (a diverged model
+        checkpointing on cadence would otherwise rotate every good archive
+        out of ``keep_last`` within N saves)."""
+        arrays = self._as_arrays(weights)
         meta = dict(metadata or {})
         meta["step"] = int(step)
+        finite = all(_is_finite(a) for a in arrays.values())
+        meta["finite"] = finite
+        meta["arrays"] = {
+            key: {
+                "crc": _array_crc(a),
+                "dtype": str(a.dtype),
+                "shape": list(a.shape),
+            }
+            for key, a in arrays.items()
+        }
         buf = io.BytesIO()
         np.savez(buf, __meta__=np.frombuffer(
             json.dumps(meta).encode("utf-8"), dtype=np.uint8), **arrays)
-        final = self._path(step)
+        if not finite:
+            final = os.path.join(
+                self.directory, f"quarantine-ckpt-{int(step):012d}.npz"
+            )
+        else:
+            final = self._path(step)
         fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
         try:
             with os.fdopen(fd, "wb") as fh:
@@ -79,6 +141,16 @@ class Checkpointer:
             if os.path.exists(tmp):
                 os.unlink(tmp)
             raise
+        if not finite:
+            from ..telemetry import metrics as _metrics
+
+            _metrics.get_registry().counter("checkpoint.quarantined").inc()
+            log.error(
+                "weights at step %d are NON-FINITE: quarantined to %s "
+                "instead of overwriting good history (restore ignores it)",
+                step, final,
+            )
+            return final
         self._prune()
         log.info("checkpoint saved: %s", final)
         return final
@@ -86,8 +158,7 @@ class Checkpointer:
     def _checkpoints(self) -> list[str]:
         try:
             names = [
-                n for n in os.listdir(self.directory)
-                if n.startswith("ckpt-") and n.endswith(".npz")
+                n for n in os.listdir(self.directory) if _CKPT_RE.match(n)
             ]
         except FileNotFoundError:
             return []
@@ -105,12 +176,62 @@ class Checkpointer:
         names = self._checkpoints()
         if not names:
             return None
-        return int(names[-1][len("ckpt-") : -len(".npz")])
+        return int(_CKPT_RE.match(names[-1]).group(1))
+
+    @staticmethod
+    def _verify(path: str, meta: dict, arrays: "dict[str, np.ndarray]") -> bool:
+        """Integrity + finiteness gate for one loaded archive; False means
+        the caller must fall back to an older checkpoint. Distinct warnings
+        per failure class so an operator can tell bit-rot from divergence.
+        Archives written before the integrity meta existed verify by
+        recomputed finiteness alone."""
+        from ..telemetry import metrics as _metrics
+
+        declared = meta.get("arrays")
+        if declared is not None:
+            if sorted(declared) != sorted(arrays):
+                log.warning(
+                    "corrupt checkpoint %s: archive keys %s do not match "
+                    "the declared meta %s; trying older",
+                    path, sorted(arrays), sorted(declared),
+                )
+                _metrics.get_registry().counter(
+                    "checkpoint.restore_corrupt").inc()
+                return False
+            for key, spec in declared.items():
+                a = arrays[key]
+                if (
+                    str(a.dtype) != spec["dtype"]
+                    or list(a.shape) != list(spec["shape"])
+                    or _array_crc(a) != spec["crc"]
+                ):
+                    log.warning(
+                        "corrupt checkpoint %s: array %r failed "
+                        "CRC/shape/dtype verification; trying older",
+                        path, key,
+                    )
+                    _metrics.get_registry().counter(
+                        "checkpoint.restore_corrupt").inc()
+                    return False
+        finite = meta.get("finite")
+        if finite is None:  # legacy archive: compute what save() now records
+            finite = all(_is_finite(a) for a in arrays.values())
+        if not finite:
+            log.warning(
+                "checkpoint %s holds NON-FINITE weights (a diverged save); "
+                "trying older", path,
+            )
+            _metrics.get_registry().counter(
+                "checkpoint.restore_nonfinite").inc()
+            return False
+        return True
 
     def restore(self, step: int | None = None):
-        """(weights, metadata) of the given/latest checkpoint, or None.
-        Corrupt newest checkpoints fall back to older ones (crash-during-
-        write tolerance beyond the atomic rename)."""
+        """(weights, metadata) of the given/latest VERIFIED checkpoint, or
+        None. Falls back past unreadable archives (crash-during-write
+        tolerance beyond the atomic rename), past corrupt ones (per-array
+        CRC/shape/dtype), and past non-finite ones (divergence) — each with
+        its own warning."""
         names = self._checkpoints()
         if step is not None:
             names = [n for n in names if n == os.path.basename(self._path(step))]
@@ -120,13 +241,13 @@ class Checkpointer:
                 with np.load(path) as data:
                     meta = json.loads(bytes(data["__meta__"]).decode("utf-8"))
                     keys = [k for k in data.files if k != "__meta__"]
-                    if keys == ["w"]:
-                        weights = data["w"]
-                    else:
-                        weights = {
-                            k[len("w__"):]: data[k] for k in keys
-                        }
-                return weights, meta
+                    arrays = {k: data[k] for k in keys}
             except Exception:
                 log.warning("unreadable checkpoint %s; trying older", path)
+                continue
+            if not self._verify(path, meta, arrays):
+                continue
+            if sorted(arrays) == ["w"]:
+                return arrays["w"], meta
+            return {k[len("w__"):]: a for k, a in arrays.items()}, meta
         return None

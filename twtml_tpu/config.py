@@ -149,6 +149,21 @@ class ConfArguments:
                 f"{self.wirePack!r}"
             )
         self.recycleAfterMb: int = int(conf.get("recycleAfterMb", "0"))
+        # ingest/state robustness layer (r7)
+        self.maxQueueRows: int = int(conf.get("maxQueueRows", "0"))
+        self.shedPolicy: str = conf.get("shedPolicy", "block")
+        if self.shedPolicy not in ("block", "shed-oldest"):
+            raise ValueError(
+                "shedPolicy must be 'block' or 'shed-oldest', got "
+                f"{self.shedPolicy!r}"
+            )
+        self.sentinel: str = conf.get("sentinel", "on")
+        if self.sentinel not in ("on", "off"):
+            raise ValueError(
+                f"sentinel must be 'on' or 'off', got {self.sentinel!r}"
+            )
+        self.sentinelRollbacks: int = int(conf.get("sentinelRollbacks", "3"))
+        self.sentinelWindow: int = int(conf.get("sentinelWindow", "512"))
 
         # Multi-host process group (the reference's one-flag cluster story,
         # ConfArguments.scala:95-98 --master spark://host:port): here a
@@ -271,6 +286,28 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                                                dispatch (one scan, one stats fetch; per-batch
                                                stats preserved; stops/checkpoints land on group
                                                boundaries). Default: {self.superBatch}
+  --maxQueueRows <int rows>                    Bounded intake backpressure: cap the source→
+                                               batcher queue at this many ROWS. 0 = auto
+                                               (8 x --batchBucket when pinned, else unbounded);
+                                               -1 = explicitly unbounded. Default: {self.maxQueueRows}
+  --shedPolicy <block|shed-oldest>             Policy when the intake queue is full: 'block'
+                                               makes the producer wait (replay/backfill — no
+                                               rows lost); 'shed-oldest' drops the OLDEST
+                                               queued rows, counted in ingest.rows_shed (live
+                                               streams — freshest rows win). Default: {self.shedPolicy}
+  --sentinel <on|off>                          Divergence sentinel: checks the already-fetched
+                                               per-batch stats for NaN/Inf (zero extra host
+                                               fetches); on non-finite state rolls the model
+                                               back to the last verified-finite checkpoint
+                                               (or initial zeros without --checkpointDir),
+                                               skips the poisoning batch, and counts
+                                               model.rollbacks. Default: {self.sentinel}
+  --sentinelRollbacks <int>                    Abort the run (clean checkpointed non-zero
+                                               exit) after this many rollbacks within
+                                               --sentinelWindow batches; 0 = never abort.
+                                               Default: {self.sentinelRollbacks}
+  --sentinelWindow <int batches>               The rollback-rate window above.
+                                               Default: {self.sentinelWindow}
   --wirePack <auto|stacked|group>              Superbatch wire layout on the ragged wire:
                                                'group' coalesces the K batches into ONE
                                                contiguous buffer (one put; uint16-delta offsets)
@@ -375,6 +412,20 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                 self.printUsage(1)
         elif flag == "--recycleAfterMb":
             self.recycleAfterMb = int(take())
+        elif flag == "--maxQueueRows":
+            self.maxQueueRows = int(take())
+        elif flag == "--shedPolicy":
+            self.shedPolicy = take()
+            if self.shedPolicy not in ("block", "shed-oldest"):
+                self.printUsage(1)
+        elif flag == "--sentinel":
+            self.sentinel = take()
+            if self.sentinel not in ("on", "off"):
+                self.printUsage(1)
+        elif flag == "--sentinelRollbacks":
+            self.sentinelRollbacks = int(take())
+        elif flag == "--sentinelWindow":
+            self.sentinelWindow = int(take())
         elif flag == "--faultEvery":
             self.faultEvery = int(take())
         elif flag == "--chaos":
@@ -426,6 +477,20 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
         if self.wirePack != "auto":
             return self.wirePack
         return "stacked"
+
+    def effective_max_queue_rows(self) -> int:
+        """Resolve ``--maxQueueRows``: explicit > 0 wins; 0 (the default)
+        sizes the bound from the batch size — 8 pinned row buckets is deep
+        enough that the fill gate and a ``--superBatch`` group never
+        starve, shallow enough that a stalled consumer bounds host RSS at
+        ~8 batches of parsed rows. Without a pinned bucket there is no
+        batch size to derive from, so 0 stays unbounded (as does an
+        explicit -1)."""
+        if self.maxQueueRows > 0:
+            return self.maxQueueRows
+        if self.maxQueueRows < 0:
+            return 0
+        return 8 * self.batchBucket if self.batchBucket > 0 else 0
 
     def local_shards(self) -> int | None:
         """Parse Spark-style local[N] master hints; None means use all devices."""

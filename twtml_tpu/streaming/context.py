@@ -82,17 +82,142 @@ def _watched_allgather(arr, timeout_s: float):
     return box["out"]
 
 
+SHED_POLICIES = ("block", "shed-oldest")
+
+
 class _RowCountQueue(queue.Queue):
     """queue.Queue that also tracks the queued ROW count (a ParsedBlock item
     counts its rows, a Status counts 1) — maintained inside ``_put``/``_get``,
     which run under the queue's own mutex, so the per-tweet intake path pays
     no extra lock. The back-to-back fill gate compares ``rows_queued`` (not
     item count) to the row bucket; reading the int without the mutex is fine
-    for a gate that only ever errs toward one more 2 ms wait."""
+    for a gate that only ever errs toward one more 2 ms wait.
+
+    **Bounded backpressure (r7)**: ``configure_bound`` arms a ROW-count
+    ceiling (``--maxQueueRows``) with two overload policies — the intake
+    queue was the last unbounded buffer in the pipeline (a source burst or
+    a slow tunnel phase grew host RSS without limit, compounding the known
+    axon-client retention, BENCHMARKS.md r3 soak):
+
+    - ``block`` (default): the producer thread waits until the consumer
+      drains below the bound — correct for replay/backfill sources, where
+      the data can't be lost and the file isn't going anywhere;
+    - ``shed-oldest``: drop whole items from the queue FRONT until the new
+      item fits — correct for live sources, where the freshest rows are
+      the valuable ones and blocking would just move the loss upstream
+      into the kernel socket buffer. Shedding from the front never
+      reorders the survivors (parity: predict-then-train ordering holds
+      on whatever rows remain — tests/test_backpressure.py).
+
+    Shed rows are counted (``ingest.rows_shed``); an item bigger than the
+    whole bound is admitted alone (blocking it forever would deadlock the
+    stream on one oversized block). ``close()`` releases a blocked
+    producer at shutdown. Unbounded (``max_rows=0``) puts take the exact
+    pre-r7 path."""
+
+    max_rows = 0
+    policy = "block"
 
     def _init(self, maxsize: int) -> None:
         super()._init(maxsize)
         self.rows_queued = 0
+        self.rows_shed_total = 0
+        self._closed = False
+
+    def configure_bound(self, max_rows: int, policy: str = "block") -> None:
+        if policy not in SHED_POLICIES:
+            raise ValueError(
+                f"shed policy must be one of {SHED_POLICIES}, got {policy!r}"
+            )
+        self.max_rows = max(0, int(max_rows))
+        self.policy = policy
+
+    def close(self) -> None:
+        """Release producers blocked on a full bounded queue (shutdown:
+        the consumer is gone, so waiting would wedge ``Source.stop``)."""
+        with self.mutex:
+            self._closed = True
+            self.not_full.notify_all()
+
+    def put(self, item, block=True, timeout=None) -> None:
+        if self.max_rows <= 0:
+            return super().put(item, block, timeout)
+        rows = getattr(item, "rows", 1)
+        with self.not_full:
+            if self.policy == "block":
+                # admit when empty regardless of size: one item larger
+                # than the whole bound must pass, not deadlock
+                while (
+                    self.rows_queued > 0
+                    and self.rows_queued + rows > self.max_rows
+                    and not self._closed
+                ):
+                    # timed wait belt-and-braces: queue.Queue.get always
+                    # notifies not_full, but a missed wakeup must not
+                    # wedge the producer forever
+                    self.not_full.wait(0.1)
+            else:  # shed-oldest
+                shed = 0
+                while self.queue and self.rows_queued + rows > self.max_rows:
+                    old = self.queue.popleft()
+                    r = getattr(old, "rows", 1)
+                    self.rows_queued -= r
+                    shed += r
+                if shed:
+                    self.rows_shed_total += shed
+                    reg = _metrics.get_registry()
+                    reg.counter("ingest.rows_shed").inc(shed)
+                    reg.gauge("ingest.queue_rows").set(self.rows_queued)
+                    log.warning(
+                        "intake queue over --maxQueueRows %d: shed %d "
+                        "oldest row(s) to admit %d new (total shed %d)",
+                        self.max_rows, shed, rows, self.rows_shed_total,
+                    )
+            self._put(item)
+            self.unfinished_tasks += 1
+            self.not_empty.notify()
+
+    def putback(self, item) -> None:
+        """Return an item to the FRONT of the queue (the drain splitter's
+        remainder — it must come out first so row order is preserved).
+        Exempt from the bound: these rows were already admitted once."""
+        with self.mutex:
+            self.queue.appendleft(item)
+            self.rows_queued += getattr(item, "rows", 1)
+            self.not_empty.notify()
+
+    def drain_rows(self, limit: int = 0, slicer=None):
+        """Pop queued items up to ``limit`` ROWS (0 = everything) under ONE
+        mutex acquire, splitting an overshooting block via ``slicer(item,
+        cut) -> (head, tail)`` with the tail left at the queue front.
+
+        Why not get_nowait in a loop: every ``Queue.get`` notifies
+        ``not_full``, so a 2048-row drain woke a bound-blocked producer
+        2048 times to re-check and re-sleep against a still-full queue —
+        measurable lock churn on the one-core host. One acquire + one
+        ``notify_all`` per drain instead, and the producer wakes exactly
+        once, into a freshly drained bound."""
+        out: list = []
+        rows = 0
+        with self.mutex:
+            while self.queue and (not limit or rows < limit):
+                item = self.queue[0]
+                take = getattr(item, "rows", None)
+                if take is not None and limit and rows + take > limit:
+                    cut = limit - rows
+                    head, tail = slicer(item, cut)
+                    self.queue[0] = tail
+                    self.rows_queued -= cut
+                    out.append(head)
+                    rows = limit
+                    break
+                self.queue.popleft()
+                taken = take if take is not None else 1
+                self.rows_queued -= taken
+                rows += taken
+                out.append(item)
+            self.not_full.notify_all()
+        return out
 
     def _put(self, item) -> None:
         super()._put(item)
@@ -102,14 +227,6 @@ class _RowCountQueue(queue.Queue):
         item = super()._get()
         self.rows_queued -= getattr(item, "rows", 1)
         return item
-
-    def putback(self, item) -> None:
-        """Return an item to the FRONT of the queue (the drain splitter's
-        remainder — it must come out first so row order is preserved)."""
-        with self.mutex:
-            self.queue.appendleft(item)
-            self.rows_queued += getattr(item, "rows", 1)
-            self.not_empty.notify()
 
 
 class RawStream:
@@ -219,7 +336,7 @@ class FeatureStream(RawStream):
         side-channel only — the batch itself is untouched."""
         tr = _trace.get()
         if not tr.enabled:
-            return self._featurize_impl(statuses)
+            return self._poison_gate(statuses, self._featurize_impl(statuses))
         with tr.span("featurize", items=len(statuses)) as sp:
             batch = self._featurize_impl(statuses)
             from ..features.batch import wire_nbytes
@@ -229,7 +346,20 @@ class FeatureStream(RawStream):
                 valid=batch.num_valid,
                 wire_bytes=wire_nbytes(batch),
             )
-        return batch
+        return self._poison_gate(statuses, batch)
+
+    @staticmethod
+    def _poison_gate(statuses: list, batch):
+        """--chaos ``source.nan`` injection point: only REAL batches count
+        toward (and may fire) the rule — warmup/all-padding featurizes pass
+        ``statuses=[]`` and must not advance the per-host call counter
+        (lockstep hosts featurize in step; a dry host skewing the counter
+        would desynchronize deterministic triggers across the group)."""
+        from . import faults as _faults_inner
+
+        if not statuses or _faults_inner._CHAOS is None:
+            return batch
+        return _faults_inner.maybe_poison_labels(batch)
 
     @staticmethod
     def _record_metrics(batch) -> None:
@@ -299,9 +429,15 @@ class FeatureStream(RawStream):
 
 
 class StreamingContext:
-    def __init__(self, batch_interval: float = 5.0):
+    def __init__(self, batch_interval: float = 5.0,
+                 max_queue_rows: int = 0, shed_policy: str = "block"):
+        """``max_queue_rows``/``shed_policy`` arm the bounded intake queue
+        (``--maxQueueRows``/``--shedPolicy`` — see _RowCountQueue); 0 keeps
+        the pre-r7 unbounded queue (tests and embedded uses)."""
         self.batch_interval = batch_interval
         self._queue: _RowCountQueue = _RowCountQueue()
+        if max_queue_rows > 0:
+            self._queue.configure_bound(max_queue_rows, shed_policy)
         self._source: Source | None = None
         self._stream: RawStream | None = None
         self._scheduler: threading.Thread | None = None
@@ -311,6 +447,11 @@ class StreamingContext:
         # set when a lockstep run aborted (this host or a peer): the app
         # must surface a failure instead of reporting success
         self.failed = False
+        # divergence-sentinel hook (apps/common.DivergenceSentinel.bind_ssc):
+        # returns this host's cumulative rollback count, so the decision
+        # rides the per-tick cadence allgather in lockstep runs and every
+        # host can verify the group rolled back the same steps
+        self.rollback_count_fn: "Callable[[], int] | None" = None
 
     def source_stream(
         self,
@@ -364,24 +505,20 @@ class StreamingContext:
         return out
 
     def _drain_impl(self, limit: int = 0) -> list[Status]:
-        out: list[Status] = []
-        rows = 0
-        while not limit or rows < limit:
-            try:
-                item = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            take = getattr(item, "rows", None)
-            if take is not None and limit and rows + take > limit:
-                from ..features.blocks import slice_block
+        from ..features.blocks import slice_block
 
-                cut = limit - rows
-                out.append(slice_block(item, 0, cut))
-                self._queue.putback(slice_block(item, cut, take))
-                rows = limit
-                break
-            out.append(item)
-            rows += take if take is not None else 1
+        out = self._queue.drain_rows(
+            limit,
+            slicer=lambda item, cut: (
+                slice_block(item, 0, cut),
+                slice_block(item, cut, item.rows),
+            ),
+        )
+        # queue depth is per-BATCH registry state (one gauge set per drain,
+        # never per tweet — the intake hot path pays no metric lock)
+        _metrics.get_registry().gauge("ingest.queue_rows").set(
+            self._queue.rows_queued
+        )
         return out
 
     def _run_batch(self, statuses: list[Status], batch_time: float) -> None:
@@ -490,11 +627,19 @@ class StreamingContext:
                 stream.row_bucket, stream.token_bucket,
                 len(statuses) - len(kept),
             )
+            # registry state, not log-only (r7): dropped rows must show on
+            # /api/metrics next to the other ingest-loss counters
+            _metrics.get_registry().counter(
+                "ingest.rows_dropped_overflow"
+            ).inc(len(statuses) - len(kept))
             batch = stream._featurize(kept)
             if stream.bucket_overflow(batch):
                 # probe missed (e.g. a case fold changed the length):
                 # last resort keeps alignment at the cost of the batch
                 log.error("overflow persists; dropping the whole batch")
+                _metrics.get_registry().counter(
+                    "ingest.rows_dropped_overflow"
+                ).inc(len(kept))
                 batch = stream._featurize([])
         stream._record_metrics(batch)
         for fn in stream._outputs:
@@ -560,11 +705,21 @@ class StreamingContext:
             local = self._drain(limit)
             rows = sum(getattr(s, "rows", 1) for s in local)
             more = (not self._source.exhausted) or self._queue.rows_queued > 0
+            # the divergence sentinel's rollback count rides the SAME
+            # cadence allgather (zero extra collectives): stats are
+            # psum-global and deliveries deterministic, so every host
+            # reaches the same verdict at the same step — the gathered
+            # counts verify that instead of assuming it
+            rollbacks = (
+                int(self.rollback_count_fn())
+                if self.rollback_count_fn is not None
+                else 0
+            )
             try:
                 flags = _watched_allgather(
                     np.array(
                         [rows > 0 and not aborting, more and not aborting,
-                         aborting],
+                         aborting, rollbacks],
                         dtype=np.int32,
                     ),
                     watch_s,
@@ -601,6 +756,21 @@ class StreamingContext:
                 # it in the same tick, so everyone can stop dispatching
                 if not aborting:
                     log.critical("a peer host aborted the lockstep run")
+                self.failed = True
+                break
+            if flags.shape[1] > 3 and len(set(flags[:, 3].tolist())) > 1:
+                # sentinel rollbacks must land on the SAME step on every
+                # host (global stats + deterministic deliveries guarantee
+                # it); disagreement means the hosts' model states have
+                # diverged — abort the group rather than train past it
+                log.critical(
+                    "lockstep hosts disagree on sentinel rollback counts "
+                    "%s — model states have diverged; aborting the group",
+                    flags[:, 3].tolist(),
+                )
+                _metrics.get_registry().counter(
+                    "lockstep.rollback_disagreements"
+                ).inc()
                 self.failed = True
                 break
             if flags[:, 0].any():
@@ -641,6 +811,9 @@ class StreamingContext:
 
     def stop(self) -> None:
         self._stop.set()
+        # release a producer blocked on a full bounded queue FIRST, or the
+        # source's join would time out against a wedged put()
+        self._queue.close()
         if self._source is not None:
             self._source.stop()
         if self._scheduler is not None:

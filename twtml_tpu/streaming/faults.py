@@ -22,19 +22,36 @@ three injection points —
 so the runtime guards those points carry (fetch deadline/retry/abort, the
 publish circuit breaker, the lockstep watchdogs) are testable end-to-end.
 
+r7 adds SOURCE/PARSE chaos — the untrusted-data failure domain the ingest
+guards exist for (bounded backpressure, the divergence sentinel, verified
+checkpoints):
+
+- ``source.garbage`` — corrupt (truncate + garble) a block source's raw
+  byte buffer before the parser sees it: the parser must skip, count, and
+  never crash (one corrupted chunk can also bleed into the next via the
+  carry, exactly like real wire damage),
+- ``source.burst``  — re-emit the current item N extra times (a rate
+  spike), exercising the bounded intake queue's block/shed policies,
+- ``source.nan``    — poison every valid label of the current featurized
+  batch with NaN: the model diverges in one step, exercising the
+  divergence sentinel's rollback-to-verified-checkpoint path.
+
 Spec grammar (comma-separated clauses):
 
-    TARGET:ACTION[@TRIGGER]   or   seed=N
+    TARGET[:ACTION][@TRIGGER]   or   seed=N
 
     ACTION   delay=SECONDS (sleep before the call — a spike or a stall,
              depending on magnitude; ``stall=`` is an alias) | error
-             (raise InjectedFault instead of the call)
+             (raise InjectedFault instead of the call) — fetch/step/web
+             targets only. ``source.*`` targets take no action (the
+             injection IS the action), except ``source.burst:rows=N``
+             (extra re-emits per firing; default 4).
     TRIGGER  N       every Nth call of that target (deterministic)
              pP      probability P per call (seeded RNG)
              fromN   every call from the Nth on (a permanent outage)
              default: every call
 
-Example: ``--chaos "fetch:delay=2@3,web:error@p0.5,step:stall=5@from40,seed=7"``
+Example: ``--chaos "fetch:delay=2@3,source.nan@5,source.burst:rows=8@p0.1,seed=7"``
 """
 
 from __future__ import annotations
@@ -49,7 +66,12 @@ from .sources import Source
 
 log = get_logger("streaming.faults")
 
-CHAOS_TARGETS = ("fetch", "step", "web")
+TRANSPORT_TARGETS = ("fetch", "step", "web")
+SOURCE_TARGETS = ("source.garbage", "source.burst", "source.nan")
+CHAOS_TARGETS = TRANSPORT_TARGETS + SOURCE_TARGETS
+
+# extra re-emits per source.burst firing when the rule gives no rows=N
+BURST_DEFAULT_EXTRA = 4
 
 
 class InjectedFault(ConnectionError):
@@ -77,7 +99,11 @@ class _ChaosRule:
         return rng.random() < self.param
 
     def __repr__(self) -> str:  # shows up in the install log line
-        act = "error" if self.kind == "error" else f"delay={self.value:g}s"
+        act = (
+            "error" if self.kind == "error"
+            else "inject" if self.kind == "inject"
+            else f"delay={self.value:g}s"
+        )
         trig = {"every": "every %d", "from": "from call %d on",
                 "prob": "p=%g"}[self.mode] % self.param
         return f"{self.target}:{act} ({trig})"
@@ -119,15 +145,35 @@ class ChaosInjector:
             if clause.startswith("seed="):
                 seed = int(clause[len("seed="):])
                 continue
-            target, sep, action = clause.partition(":")
-            if not sep or target not in CHAOS_TARGETS:
+            body, _, trigger = clause.partition("@")
+            target, sep, action = body.partition(":")
+            if target not in CHAOS_TARGETS:
                 raise ValueError(
-                    f"bad chaos clause {clause!r}: want TARGET:ACTION with "
+                    f"bad chaos clause {clause!r}: want TARGET[:ACTION] with "
                     f"TARGET in {CHAOS_TARGETS}"
                 )
-            action, _, trigger = action.partition("@")
             mode, param = _parse_trigger(trigger) if trigger else ("every", 1)
-            if action == "error":
+            if target in SOURCE_TARGETS:
+                # the injection IS the action; only source.burst takes a
+                # magnitude (rows=N extra re-emits per firing)
+                if action.startswith("rows="):
+                    if target != "source.burst":
+                        raise ValueError(
+                            f"rows= only applies to source.burst, not {clause!r}"
+                        )
+                    value = int(action.partition("=")[2])
+                    if value < 1:
+                        raise ValueError(f"non-positive rows in {clause!r}")
+                elif action:
+                    raise ValueError(
+                        f"bad chaos action {action!r} in {clause!r}: "
+                        "source targets take no action (source.burst "
+                        "accepts rows=N)"
+                    )
+                else:
+                    value = BURST_DEFAULT_EXTRA
+                rules.append(_ChaosRule(target, "inject", value, mode, param))
+            elif action == "error":
                 rules.append(_ChaosRule(target, "error", 0.0, mode, param))
             elif action.startswith(("delay=", "stall=")):
                 value = float(action.partition("=")[2])
@@ -179,6 +225,31 @@ class ChaosInjector:
         if raise_after:
             raise InjectedFault(f"injected {target} fault (call #{n})")
 
+    def should(self, target: str) -> "float | None":
+        """Source-injection query: count one call of ``target`` and return
+        the firing rule's magnitude (``source.burst`` rows; 1 otherwise), or
+        None when nothing fires. Never sleeps or raises — the CALLER owns
+        the injection (corrupting bytes, duplicating emits, poisoning
+        labels), this just decides whether and how much."""
+        rules = self._rules.get(target)
+        if not rules:
+            return None
+        with self._lock:
+            self._calls[target] += 1
+            n = self._calls[target]
+            fired = [r for r in rules if r.fires(n, self._rng)]
+        if not fired:
+            return None
+        from ..telemetry import metrics as _metrics
+
+        reg = _metrics.get_registry()
+        value = 0.0
+        for r in fired:
+            reg.counter("chaos.injected").inc()
+            reg.counter(f"chaos.{target}.injected").inc()
+            value = max(value, r.value)
+        return value
+
     def calls(self, target: str) -> int:
         return self._calls.get(target, 0)
 
@@ -215,6 +286,82 @@ def perturb(target: str) -> None:
     installed (one global read on the hot path)."""
     if _CHAOS is not None:
         _CHAOS.perturb(target)
+
+
+# -- source/parse injection points (r7 — the ingest-guard failure domain) ----
+
+
+def maybe_corrupt_block(data: bytes) -> bytes:
+    """``source.garbage`` injection point (block sources' bytes → parser
+    stage): truncate the buffer mid-line and garble a window, simulating a
+    torn/damaged wire chunk. The parser contract (skip malformed lines,
+    never crash, count the skips) absorbs it; the truncated tail rides the
+    carry into the next chunk like real damage would.
+
+    Buffers under 256 bytes pass untouched (and don't count a call): the
+    parser's capacity/tail loops re-parse their own shrinking carry, and
+    re-corrupting every remnant would chase it to zero forever instead of
+    modeling one damaged chunk."""
+    if _CHAOS is None or len(data) < 256:
+        return data
+    if _CHAOS.should("source.garbage") is None:
+        return data
+    cut = max(1, len(data) * 2 // 3)
+    corrupted = bytearray(data[:cut])
+    lo = max(0, cut // 2 - 16)
+    for i in range(lo, min(len(corrupted), lo + 32)):
+        corrupted[i] ^= 0xFF
+    log.warning(
+        "chaos: corrupted a %d-byte block buffer (truncated to %d, "
+        "garbled 32 bytes)", len(data), cut,
+    )
+    return bytes(corrupted)
+
+
+def burst_extra() -> int:
+    """``source.burst`` injection point (source emit loop): number of EXTRA
+    re-emits of the current item this call (0 = no burst). A burst of
+    duplicated items is a rate spike the bounded intake queue must absorb
+    (block) or shed (shed-oldest) — rows, not wall-clock, is what the
+    backpressure bound meters."""
+    if _CHAOS is None:
+        return 0
+    v = _CHAOS.should("source.burst")
+    return int(v) if v else 0
+
+
+def maybe_poison_labels(batch):
+    """``source.nan`` injection point (featurize stage): return ``batch``
+    with every VALID row's label poisoned to NaN (padding rows keep their
+    zeros — the learner multiplies by mask, and poisoned padding would
+    taint even batches the rule never fired on). One poisoned batch drives
+    the fused predict-then-train step's weights non-finite in a single
+    update — the exact event the divergence sentinel exists to catch."""
+    if _CHAOS is None:
+        return batch
+    if _CHAOS.should("source.nan") is None:
+        return batch
+    import numpy as np
+
+    label = np.array(batch.label, copy=True)
+    valid = np.asarray(batch.mask) > 0
+    if not valid.any():
+        return batch
+    label[valid] = np.nan
+    log.warning(
+        "chaos: poisoned %d label(s) with NaN in a %d-row batch",
+        int(valid.sum()), label.shape[0],
+    )
+    if hasattr(batch, "_replace"):  # FeatureBatch / UnitBatch NamedTuples
+        return batch._replace(label=label)
+    from ..features.batch import RaggedUnitBatch
+
+    if isinstance(batch, RaggedUnitBatch):
+        return RaggedUnitBatch(
+            batch.units, batch.offsets, batch.numeric, label, batch.mask,
+            row_len=batch.row_len, num_shards=batch.num_shards,
+        )
+    raise TypeError(f"source.nan cannot poison a {type(batch).__name__}")
 
 
 class FaultInjectingSource(Source):

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import queue
+import random
 import threading
 import time
 from typing import Callable, Iterator
@@ -30,6 +31,42 @@ from ..features.featurizer import Status
 from ..utils import get_logger
 
 log = get_logger("streaming.sources")
+
+# lazily-bound faults module (faults.py imports Source from here, so a
+# module-scope import back would be circular); cached so the per-emit hot
+# path pays one global read + one is-None check when chaos is off
+_faults_mod = None
+
+
+def _burst_extra() -> int:
+    global _faults_mod
+    if _faults_mod is None:
+        from . import faults
+
+        _faults_mod = faults
+    if _faults_mod._CHAOS is None:
+        return 0
+    return _faults_mod.burst_extra()
+
+
+def _maybe_corrupt(data: bytes) -> bytes:
+    global _faults_mod
+    if _faults_mod is None:
+        from . import faults
+
+        _faults_mod = faults
+    if _faults_mod._CHAOS is None:
+        return data
+    return _faults_mod.maybe_corrupt_block(data)
+
+
+def _count_parse_drops(n: int) -> None:
+    """Malformed/garbage lines the block parser skipped — registry state
+    (``ingest.rows_dropped_parse``) instead of log-only, so wire damage is
+    visible on /api/metrics next to the other ingest-loss counters."""
+    from ..telemetry import metrics as _metrics
+
+    _metrics.get_registry().counter("ingest.rows_dropped_parse").inc(n)
 
 
 class Source:
@@ -68,6 +105,9 @@ class Source:
                         return
                     self._emit(status)
                     emitted_any = True
+                    extra = _burst_extra()  # --chaos source.burst rate spike
+                    for _ in range(extra):
+                        self._emit(status)
                 self._exhausted.set()
                 return  # clean end of stream
             except Exception as exc:
@@ -85,6 +125,14 @@ class Source:
                     self._exhausted.set()
                     return
                 backoff = self._backoff(exc, restarts)
+                # a flapping stream must be VISIBLE, not a silent retry
+                # loop buried in logs: restarts are first-class registry
+                # state (total + per source name) for /api/metrics
+                from ..telemetry import metrics as _metrics
+
+                reg = _metrics.get_registry()
+                reg.counter("source.restarts").inc()
+                reg.counter(f"source.{self.name}.restarts").inc()
                 log.exception(
                     "source %s crashed; restart %d/%d in %.1fs",
                     self.name, restarts, self.max_restarts, backoff,
@@ -92,15 +140,26 @@ class Source:
                 if self._stop.wait(backoff):
                     return
 
+    # restart backoff ceiling; class-level so a subclass (or a test) can
+    # tighten it without re-deriving the ladder
+    BACKOFF_CAP_S = 30.0
+
     def _backoff(self, exc: Exception, restarts: int) -> float:
         """Seconds to sleep before restart ``restarts`` (1-based) after
-        ``exc``. Default: exponential from ``restart_backoff``, capped at
-        30s. Subclasses override for error-class-aware policies (the live
-        Twitter receiver distinguishes rate-limit vs HTTP vs transport
-        failures, twitter.py). The exponent is capped too: restarts can
-        reach the millions in unbounded chaos runs and 2**n overflows."""
+        ``exc``. Default: exponential from ``restart_backoff``, JITTERED
+        (uniform in [0.5x, 1x] of the ladder value — N restarting shards
+        of one dead upstream must not reconnect in phase) and capped at
+        ``BACKOFF_CAP_S``. Subclasses override for error-class-aware
+        policies (the live Twitter receiver distinguishes rate-limit vs
+        HTTP vs transport failures, twitter.py). The exponent is capped
+        too: restarts can reach the millions in unbounded chaos runs and
+        2**n overflows."""
         del exc
-        return min(self.restart_backoff * (2 ** min(restarts - 1, 12)), 30.0)
+        base = min(
+            self.restart_backoff * (2 ** min(restarts - 1, 12)),
+            self.BACKOFF_CAP_S,
+        )
+        return base * (0.5 + 0.5 * random.random())
 
     # how long stop() waits for the producer thread; class-level so tests
     # can shrink it without monkeypatching join()
@@ -233,11 +292,15 @@ class BlockParserMixin:
         from ..features import native
         from ..features.blocks import ParsedBlock
 
+        # --chaos source.garbage: damage the buffer BEFORE the parser —
+        # the skip-and-count contract below is what absorbs it
+        data = _maybe_corrupt(data)
         out = native.parse_tweet_block(data, self.begin, self.end, copy=self.copy)
         if out is not None:
             numeric, units, offsets, ascii_flags, consumed, bad = out
             if bad:
                 log.warning("block parser skipped %d malformed lines", bad)
+                _count_parse_drops(bad)
             return (
                 ParsedBlock(numeric, units, offsets, ascii_flags),
                 data[consumed:],
@@ -303,6 +366,7 @@ class BlockParserMixin:
                 # same contract as the C parser: malformed lines (including
                 # valid JSON that isn't a tweet object) skip, never crash
                 log.warning("block parser skipped a malformed line")
+                _count_parse_drops(1)
                 continue
             o = status.retweeted_status
             if o is not None and self.begin <= o.retweet_count <= self.end:

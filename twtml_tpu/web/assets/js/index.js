@@ -50,6 +50,14 @@
       String(gauges["host.rss_mb"] || 0);
     document.getElementById("fetchDepth").textContent =
       String(gauges["fetch.queue_depth"] || 0);
+    // ingest/state robustness (bounded queue + divergence sentinel)
+    document.getElementById("queueRows").textContent =
+      String(gauges["ingest.queue_rows"] || 0);
+    document.getElementById("rowsShed").textContent =
+      String(counters["ingest.rows_shed"] || 0);
+    const rb = document.getElementById("rollbacks");
+    rb.textContent = String(counters["model.rollbacks"] || 0);
+    rb.classList.toggle("degraded", (counters["model.rollbacks"] || 0) > 0);
   }
 
   function onMessage(json) {
