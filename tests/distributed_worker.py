@@ -42,6 +42,102 @@ def main() -> None:
         f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid
     )
 
+    if mesh_kind == "sideband":
+        # fleet observability (ISSUE 5): a REAL two-process lockstep run
+        # with host 1 artificially delayed via --chaos step:delay (the
+        # injection sits INSIDE the dispatch timing window, so the stall
+        # attributes to the upload stage). Both hosts gather the same
+        # sideband matrix on the one cadence allgather; both must name
+        # host 1 as the straggler. The allgather itself is counted so the
+        # test proves the sideband added NO collective, and jax.device_get
+        # is counted so it proves no added host fetch.
+        #
+        # The per-host model is deliberately HOST-LOCAL (no collectives in
+        # the step): on this test's CPU backend collective execution is
+        # synchronous, so a stall on one host would spread into every
+        # peer's dispatch wall time through the in-step rendezvous and no
+        # skew could be observed (on the real async-dispatch transport the
+        # wait happens on device instead). A collective-free step keeps
+        # each host's stage clocks its own, and makes the cadence
+        # allgather the ONLY collective in the loop — exactly what the
+        # zero-added-collectives count asserts against.
+        import jax.experimental.multihost_utils as mh
+
+        from twtml_tpu.apps.common import FetchPipeline
+        from twtml_tpu.features.featurizer import Featurizer
+        from twtml_tpu.models import StreamingLinearRegressionWithSGD
+        from twtml_tpu.streaming import faults as _faults
+        from twtml_tpu.streaming.context import StreamingContext
+        from twtml_tpu.streaming.sources import ShardedSource, SyntheticSource
+        from twtml_tpu.telemetry import metrics as _metrics
+        from twtml_tpu.telemetry import sideband as _sideband
+
+        if pid == 1:
+            _faults.install_chaos("step:delay=0.12")
+
+        counts = {"allgather": 0, "get": 0}
+        real_ag = mh.process_allgather
+
+        def counting_ag(arr):
+            counts["allgather"] += 1
+            return real_ag(arr)
+
+        mh.process_allgather = counting_ag
+        real_get = jax.device_get
+
+        def counting_get(x):
+            counts["get"] += 1
+            return real_get(x)
+
+        jax.device_get = counting_get
+
+        model = StreamingLinearRegressionWithSGD(
+            num_iterations=5, step_size=0.005
+        )
+
+        ssc = StreamingContext(batch_interval=0)
+        stream = ssc.source_stream(
+            ShardedSource(
+                SyntheticSource(total=192, seed=7, base_ms=1785320000000),
+                pid, nprocs,
+            ),
+            Featurizer(now_ms=1785320000000),
+            row_bucket=16, token_bucket=64, row_multiple=2,
+            device_hash=True,
+        )
+        pipe = FetchPipeline(
+            model, lambda out, b, t, at_boundary: None,
+            deterministic=True,
+        )
+        stream.foreach_batch(pipe.on_batch)
+        ssc.start(lockstep=True)
+        terminated = ssc.await_termination(timeout=120)
+        ssc.stop()
+        pipe.flush()
+
+        reg = _metrics.get_registry().snapshot()
+        view = _sideband.last_hosts()
+        print(json.dumps({
+            "process": pid,
+            "terminated": bool(terminated),
+            "failed": bool(ssc.failed),
+            "batches": int(ssc.batches_processed),
+            "ticks": int(reg["counters"].get("lockstep.ticks", 0)),
+            "allgathers": counts["allgather"],
+            "device_gets": counts["get"],
+            "fetch_count": int(reg["counters"].get("fetch.count", 0)),
+            "straggler_host": int(
+                reg["gauges"].get("lockstep.straggler_host", -2)
+            ),
+            "tick_skew_ms": float(
+                reg["gauges"].get("lockstep.tick_skew_ms", 0.0)
+            ),
+            "view_straggler": view["straggler"] if view else None,
+            "view_stage": view["stage"] if view else None,
+            "num_hosts_seen": len(view["hosts"]) if view else 0,
+        }), flush=True)
+        return
+
     if mesh_kind in ("lockstep_abort", "peer_kill"):
         # the anti-hang machinery. lockstep_abort: host 1's batch handler
         # raises mid-run; its loop must broadcast abort so host 0 STOPS

@@ -49,6 +49,10 @@ def dashboard(defer_series=False):
         "jsonClass": "Stats", "count": 0, "batch": 0, "mse": 0,
         "realStddev": 0, "predStddev": 0,
     }
+    h.fetch_routes["/api/hosts"] = {
+        "jsonClass": "Hosts", "hosts": [], "straggler": -1, "stage": "",
+        "skewMs": 0.0,
+    }
     series = h.defer("/api/series") if defer_series else None
     if not defer_series:
         h.fetch_routes["/api/series"] = []
@@ -171,10 +175,66 @@ def test_metrics_frame_updates_ingest_guard_tiles():
     assert "degraded" not in h.el("rollbacks").class_set
 
 
+def test_metrics_frame_updates_latency_tile():
+    """r8: the derived fetch-latency p95 (Metrics.histograms, seconds)
+    renders in ms on the pipeline panel."""
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="Metrics", counters={}, gauges={},
+        health={"phase": "healthy", "rtt_ms": 70.0, "transitions": 0},
+        histograms={"fetch.latency_s": {"count": 9, "mean": 0.07,
+                    "p50": 0.064, "p95": 0.128, "p99": 0.256}},
+    ))
+    assert h.el("fetchP95").text == "128.0"
+    # a Metrics frame without histograms resets the tile, never throws
+    h.ws.server_message(frame(
+        jsonClass="Metrics", counters={}, gauges={},
+        health={"phase": "healthy", "rtt_ms": 70.0, "transitions": 0},
+    ))
+    assert h.el("fetchP95").text == "0.0"
+
+
+def test_hosts_frame_builds_tiles_and_names_straggler():
+    """r8 Hosts tiles: one tile per host from the sideband view, the
+    gating host highlighted with the ladder stage, tick skew shown."""
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="Hosts",
+        hosts=[{"host": 0, "tick_prep_ms": 12.4},
+               {"host": 1, "tick_prep_ms": 141.7}],
+        straggler=1, stage="upload", skewMs=129.3,
+    ))
+    assert h.el("straggler").text == "host 1 · upload"
+    assert "degraded" in h.el("straggler").class_set
+    assert h.el("tickSkew").text == "129.3"
+    tiles = h.el("hostsPanel").children
+    assert len(tiles) == 2
+    labels = [t.children[0].text for t in tiles]
+    values = [t.children[1].text for t in tiles]
+    assert labels == ["host 0", "host 1 · gating"]
+    assert values == ["12 ms", "142 ms"]
+    assert "gating" in tiles[1].class_set
+    assert "gating" not in tiles[0].class_set
+    # a healthy tick clears the highlight and rebuilds the tiles
+    h.ws.server_message(frame(
+        jsonClass="Hosts",
+        hosts=[{"host": 0, "tick_prep_ms": 10.0},
+               {"host": 1, "tick_prep_ms": 11.0}],
+        straggler=-1, stage="", skewMs=1.0,
+    ))
+    assert h.el("straggler").text == "—"
+    assert "degraded" not in h.el("straggler").class_set
+    tiles = h.el("hostsPanel").children
+    assert all("gating" not in t.class_set for t in tiles)
+
+
 def test_metrics_backfill_fetched_on_boot():
     h = dashboard()
     urls = [u for u, _ in h.fetches]
     assert "/api/metrics" in urls
+    assert "/api/hosts" in urls
 
 
 def test_unknown_jsonclass_is_ignored():

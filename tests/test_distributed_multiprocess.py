@@ -479,6 +479,35 @@ def test_app_level_multihost_sentinel_rollback(tmp_path):
     assert np.isfinite(np.asarray(state)).all()
 
 
+def test_sideband_straggler_names_delayed_host_with_no_extra_collectives():
+    """ISSUE 5 acceptance: a REAL two-process lockstep run with host 1
+    artificially delayed via --chaos (a step:delay stall inside the
+    dispatch window). The per-host sideband rides the one cadence
+    allgather — asserted by COUNTING the allgathers (exactly one per
+    lockstep tick: the cadence count is unchanged by the sideband) and the
+    jax.device_get calls (one per dispatched batch: zero added host
+    fetches) — and BOTH hosts' straggler attributors must name host 1,
+    attributed to the upload (dispatch) rung of the bottleneck ladder."""
+    outs = _run_group("unit", mesh="sideband", timeout=240.0)
+    by_pid = {o["process"]: o for o in outs}
+    for pid in (0, 1):
+        o = by_pid[pid]
+        assert o["terminated"] and not o["failed"]
+        assert o["batches"] >= 6
+        # zero added collectives: the cadence allgather count IS the tick
+        # count — the sideband widened the payload, never the call count
+        assert o["allgathers"] == o["ticks"], o
+        # zero added host fetches: one pooled device_get per dispatched
+        # batch (the FetchPipeline contract), none from the sideband
+        assert o["device_gets"] == o["batches"] == o["fetch_count"], o
+        # every host sees the whole fleet and the same verdict
+        assert o["num_hosts_seen"] == 2
+        assert o["straggler_host"] == 1, o
+        assert o["view_straggler"] == 1
+        assert o["view_stage"] == "upload", o
+        assert o["tick_skew_ms"] > 50.0, o
+
+
 def test_lockstep_abort_propagates_instead_of_hanging():
     """A batch failure on one host aborts the GROUP: the failing host
     broadcasts abort on its next tick, the healthy peer stops instead of

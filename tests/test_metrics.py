@@ -43,6 +43,29 @@ def test_histogram_semantics():
     assert h.percentile(1.0) >= 2.0
 
 
+def test_histogram_snapshot_derived_percentiles_match_percentile():
+    """r8: /api/metrics ships derived p50/p95/p99 per histogram — the
+    snapshot values must be exactly what Histogram.percentile computes
+    (one shared bucket walk), including the empty and overflow cases."""
+    reg = MetricsRegistry()
+    h = reg.histogram("fetch.latency_s")
+    assert h.snapshot()["p50"] == 0.0  # empty: all quantiles zero
+    import random
+
+    rnd = random.Random(7)
+    for _ in range(500):
+        h.observe(rnd.uniform(0.001, 4.0))
+    snap = h.snapshot()
+    for key, p in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+        assert snap[key] == h.percentile(p), key
+    assert snap["p50"] <= snap["p95"] <= snap["p99"]
+    # overflow tail: quantiles beyond the last bound report the true max
+    h2 = reg.histogram("stall_s")
+    for v in (1000.0, 2000.0, 3000.0):
+        h2.observe(v)
+    assert h2.snapshot()["p99"] == 3000.0
+
+
 def test_snapshot_isolation():
     reg = MetricsRegistry()
     reg.counter("a").inc(2)

@@ -391,6 +391,146 @@ def test_superbatcher_partial_abort_refunds_dispatch():
     sb.flush()  # clean no-op after the abort
 
 
+def _flight_recorder(tmp_path):
+    from twtml_tpu.telemetry import blackbox
+
+    blackbox.uninstall()
+    return blackbox.install(config={"app": "guards"}, out_dir=str(tmp_path))
+
+
+def _assert_bundle(tmp_path, reason_fragment, event_kind):
+    from tools import postmortem_report
+    from twtml_tpu.telemetry import blackbox
+
+    path = blackbox.last_dump_path()
+    assert path and os.path.exists(path), "no post-mortem bundle dumped"
+    assert postmortem_report.main([path]) == 0  # well-formed
+    doc = postmortem_report.load_bundle(path)
+    assert reason_fragment in doc["reason"], doc["reason"]
+    assert any(e["kind"] == event_kind for e in doc["events"]), doc["events"]
+    blackbox.uninstall()
+
+
+def test_fetch_watchdog_abort_dumps_postmortem_bundle(tmp_path):
+    """Abort path 1 (fetch-watchdog exhaustion): the abort hook funnels
+    through ssc.request_abort, which dumps the flight recorder's bundle."""
+    from twtml_tpu.streaming.context import StreamingContext
+
+    _flight_recorder(tmp_path)
+    ssc = StreamingContext()
+    model = FlakyFetchModel(slow={0: {n: 0.5 for n in range(1, 10)}})
+    pipe = FetchPipeline(
+        model, lambda out, b, t, at_boundary: None,
+        depth=1, fetch_deadline_s=0.05, fetch_retries=1,
+        abort=ssc.request_abort,
+    )
+    pipe.on_batch(0, 0.0)
+    with pytest.raises(FetchAbort):
+        pipe.on_batch(1, 0.0)
+    pipe.flush()
+    assert ssc.failed
+    _assert_bundle(tmp_path, "runtime guard", "fetch_abort")
+
+
+def test_sentinel_budget_abort_dumps_postmortem_bundle(tmp_path):
+    """Abort path 2 (sentinel rollback budget): the sentinel's abort rides
+    the same funnel; the bundle records the rollbacks and the budget
+    abort."""
+    from types import SimpleNamespace
+
+    from twtml_tpu.apps.common import DivergenceSentinel
+    from twtml_tpu.streaming.context import StreamingContext
+
+    _flight_recorder(tmp_path)
+    ssc = StreamingContext()
+
+    class _Ckpt:
+        def rollback_to_verified(self):
+            return {"step": 3}
+
+    conf = ConfArguments().parse(
+        ["--sentinelRollbacks", "1", "--sentinelWindow", "8"]
+    )
+    s = DivergenceSentinel(conf, None, _Ckpt(), ssc)
+    out = SimpleNamespace(
+        mse=float("nan"), real_stdev=1.0, pred_stdev=1.0, count=16
+    )
+    assert not s.admit(out, None)
+    assert ssc.failed
+    _assert_bundle(tmp_path, "runtime guard", "sentinel_abort")
+
+
+def test_lockstep_peer_watchdog_abort_dumps_postmortem_bundle(
+    tmp_path, monkeypatch
+):
+    """Abort path 3 (lockstep peer death): a cadence allgather that makes
+    no progress fires the peer watchdog, which aborts through the funnel
+    and leaves a bundle naming the watchdog."""
+    from jax.experimental import multihost_utils
+
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.streaming.context import StreamingContext
+    from twtml_tpu.streaming.sources import SyntheticSource
+
+    _flight_recorder(tmp_path)
+    monkeypatch.setenv("TWTML_LOCKSTEP_TIMEOUT_S", "0.2")
+    release = threading.Event()
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda arr: release.wait(10.0),  # a peer that never answers
+    )
+    ssc = StreamingContext(batch_interval=0)
+    ssc.source_stream(
+        SyntheticSource(total=16, seed=7, base_ms=1785320000000),
+        Featurizer(now_ms=1785320000000),
+        row_bucket=16, token_bucket=64, device_hash=True,
+    ).foreach_batch(lambda b, t: None)
+    ssc.start(lockstep=True)
+    assert ssc.await_termination(timeout=30)
+    release.set()
+    ssc.stop()
+    assert ssc.failed
+    _assert_bundle(tmp_path, "peer watchdog", "abort")
+
+
+def test_cadence_disagreement_abort_dumps_postmortem_bundle(
+    tmp_path, monkeypatch
+):
+    """Abort path 4 (rollback-count disagreement): fabricated gathered
+    flags whose rollback column differs across hosts abort the group and
+    leave a bundle naming the divergence."""
+    import numpy as _np
+
+    from jax.experimental import multihost_utils
+
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.streaming.context import StreamingContext
+    from twtml_tpu.streaming.sources import SyntheticSource
+
+    _flight_recorder(tmp_path)
+
+    def disagreeing(arr):
+        other = _np.array(arr, copy=True)
+        other[3] += 1  # the peer claims one more sentinel rollback
+        return _np.stack([_np.asarray(arr), other])
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", disagreeing)
+    ssc = StreamingContext(batch_interval=0)
+    ssc.source_stream(
+        SyntheticSource(total=16, seed=7, base_ms=1785320000000),
+        Featurizer(now_ms=1785320000000),
+        row_bucket=16, token_bucket=64, device_hash=True,
+    ).foreach_batch(lambda b, t: None)
+    ssc.start(lockstep=True)
+    assert ssc.await_termination(timeout=30)
+    ssc.stop()
+    assert ssc.failed
+    assert _metrics.get_registry().counter(
+        "lockstep.rollback_disagreements"
+    ).snapshot() == 1
+    _assert_bundle(tmp_path, "disagree", "abort")
+
+
 def test_superbatcher_flush_refunds_undelivered_groups():
     """Grouped dispatches (the coalesced-wire path included) that are
     in flight when the tunnel wedges: flush drops them AND refunds every

@@ -83,6 +83,60 @@ def test_instant_and_counter_events(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# size-based rotation (r8): PATH -> PATH.1, stitched reports, dropped count
+
+
+def test_trace_rotation_keeps_two_segments_and_counts_drops(tmp_path):
+    metrics_mod.reset_for_tests()
+    path = str(tmp_path / "r.trace")
+    # tiny cap: every few spans rotate the file
+    tr = trace.install(path, max_bytes=2048)
+    for i in range(200):
+        with tr.span("featurize", rows=i):
+            pass
+    trace.uninstall()
+    import os
+
+    assert os.path.exists(path) and os.path.exists(path + ".1")
+    # both segments bounded by the cap (+ one event of slack)
+    assert os.path.getsize(path) <= 2048 + 512
+    assert os.path.getsize(path + ".1") <= 2048 + 512
+    # rotations beyond the second segment DROP events, loudly counted
+    dropped = metrics_mod.get_registry().counter(
+        "trace.dropped_events"
+    ).snapshot()
+    assert dropped > 0
+    # each surviving segment is independently a valid trace
+    for p in (path + ".1",):
+        events = trace_report._load_one(p)
+        assert any(e.get("ph") == "X" for e in events)
+    # stitched load covers both segments, older first
+    stitched = trace_report.load_events(path)
+    spans = [e for e in stitched if e.get("ph") == "X"]
+    rows = [e["args"]["rows"] for e in spans]
+    assert rows == sorted(rows)  # chronological across the stitch
+    assert rows[-1] == 199  # the newest event survived
+    # accounting: every span not in a surviving segment was counted as
+    # dropped (dropped also counts each dead segment's one metadata event)
+    assert len(spans) < 200
+    assert len(spans) + dropped >= 200
+    assert trace_report.main([path]) == 0
+
+
+def test_trace_unbounded_by_default_never_rotates(tmp_path):
+    path = str(tmp_path / "u.trace")
+    tr = trace.install(path)  # max_bytes=0
+    for _ in range(100):
+        with tr.span("parse"):
+            pass
+    trace.uninstall()
+    import os
+
+    assert not os.path.exists(path + ".1")
+    assert len(trace_report.load_events(path)) >= 100
+
+
+# ---------------------------------------------------------------------------
 # trace_report as a CHECK (bench scripts gate on its exit status)
 
 

@@ -165,6 +165,54 @@ def test_metrics_roundtrip_and_default(server):
     assert got["health"]["phase"] == "degraded"
 
 
+def test_hosts_roundtrip_and_default(server):
+    """Additive Hosts messages (the lockstep fleet view): cached last-value
+    like Metrics, served at /api/hosts, unknown to legacy caches."""
+    _, url, _ = server
+    import urllib.request
+
+    with urllib.request.urlopen(url + "/api/hosts", timeout=2) as resp:
+        empty = json.loads(resp.read())
+    assert empty["jsonClass"] == "Hosts"
+    assert empty["hosts"] == [] and empty["straggler"] == -1
+
+    client = WebClient(url)
+    client.hosts(
+        [{"host": 0, "tick_prep_ms": 12.0}, {"host": 1, "tick_prep_ms": 140.0}],
+        straggler=1, stage="upload", skew_ms=128.0,
+    )
+    with urllib.request.urlopen(url + "/api/hosts", timeout=2) as resp:
+        got = json.loads(resp.read())
+    assert got["straggler"] == 1 and got["stage"] == "upload"
+    assert got["skewMs"] == 128.0
+    assert got["hosts"][1]["tick_prep_ms"] == 140.0
+
+
+def test_metrics_roundtrip_carries_derived_histograms(server):
+    """r8: the Metrics message's additive ``histograms`` field (derived
+    p50/p95/p99) round-trips; old payloads without it still decode."""
+    _, url, _ = server
+    import urllib.request
+
+    client = WebClient(url)
+    client.metrics(
+        {"pipeline.batches": 3}, {}, {"phase": "healthy"},
+        histograms={"fetch.latency_s": {
+            "count": 12, "mean": 0.07, "p50": 0.064, "p95": 0.128,
+            "p99": 0.256,
+        }},
+    )
+    with urllib.request.urlopen(url + "/api/metrics", timeout=2) as resp:
+        got = json.loads(resp.read())
+    assert got["histograms"]["fetch.latency_s"]["p95"] == 0.128
+    # a legacy Metrics payload (no histograms key) still caches cleanly
+    from twtml_tpu.telemetry.api_types import decode
+
+    legacy = decode('{"jsonClass":"Metrics","counters":{},"gauges":{},'
+                    '"health":{}}')
+    assert legacy.histograms == {}
+
+
 def test_http_post_broadcasts_to_websockets(server):
     _, url, _ = server
     ws_url = url.replace("http://", "ws://") + "/api"

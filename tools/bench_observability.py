@@ -1,0 +1,150 @@
+"""Observability-overhead neutrality check: the full r8 observability
+stack — --trace (with rotation), the sideband stage clock + per-tick
+collection, and the crash flight recorder — measured against an
+instrumentation-free control in the per-batch-telemetry regime (the regime
+where per-batch overheads bind; BENCHMARKS.md).
+
+Arms (interleaved single passes + paired per-round ratios, the house
+method — tools/pairedbench.py):
+
+- off : stage clock disabled, no tracer, no recorder — the pre-PR-1 cost
+        of the pipeline;
+- obs : trace to a rotating file + stage clock + flight recorder + one
+        sideband collection per batch (the per-tick cost a lockstep host
+        pays, charged at the worst-case cadence of every batch).
+
+Passes the acceptance gate when the paired ratio (off/obs) is >= 0.98x.
+
+Usage: python tools/bench_observability.py [--tweets N] [--batch B]
+          [--budget S]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    n_tweets, batch, budget = 65536, 2048, 120.0
+    i = 0
+    while i < len(args):
+        if args[i] == "--tweets":
+            n_tweets = int(args[i + 1]); i += 2
+        elif args[i] == "--batch":
+            batch = int(args[i + 1]); i += 2
+        elif args[i] == "--budget":
+            budget = float(args[i + 1]); i += 2
+        else:
+            raise SystemExit(f"unknown flag {args[i]!r}")
+
+    import jax
+
+    from twtml_tpu.apps.common import FetchPipeline
+    from twtml_tpu.features.batch import pack_batch
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.models import StreamingLinearRegressionWithSGD
+    from twtml_tpu.streaming.sources import SyntheticSource
+    from twtml_tpu.telemetry import blackbox as _blackbox
+    from twtml_tpu.telemetry import sideband as _sideband
+    from twtml_tpu.telemetry import trace as _trace
+
+    feat = Featurizer(now_ms=1785320000000)
+    statuses = list(SyntheticSource(total=n_tweets, seed=3).produce())
+    chunks = [statuses[i : i + batch] for i in range(0, len(statuses), batch)]
+    r_batches = [
+        feat.featurize_batch_ragged(c, row_bucket=batch, pre_filtered=True)
+        for c in chunks
+    ]
+
+    def consume(out, b, t, at_boundary=True):
+        float(out.count); float(out.mse)
+        float(out.real_stdev); float(out.pred_stdev)
+        _ = out.predictions[0]
+
+    model = StreamingLinearRegressionWithSGD()
+    seen = set()
+    for rb in r_batches:  # warm every packed layout the arms dispatch
+        key = (rb.units.shape, str(rb.units.dtype), rb.row_len)
+        if key not in seen:
+            seen.add(key)
+            float(model.step(pack_batch(rb)).mse)
+
+    tmp = tempfile.mkdtemp(prefix="bench-obs-")
+
+    def run_pass():
+        model.reset()
+        t0 = time.perf_counter()
+        pipe = FetchPipeline(model, consume, depth=8, pack=True)
+        for b in r_batches:
+            pipe.on_batch(b, 0.0)
+        pipe.flush()
+        return time.perf_counter() - t0
+
+    def off_pass():
+        _trace.uninstall()
+        _blackbox.uninstall()
+        _sideband.set_stage_clock(False)
+        try:
+            return run_pass()
+        finally:
+            _sideband.set_stage_clock(True)
+
+    collector = _sideband.SidebandCollector()
+
+    def obs_pass():
+        # rotation armed small enough to actually rotate during the pass,
+        # so the obs arm pays the rotation cost too
+        _trace.install(os.path.join(tmp, "obs.trace"),
+                       max_bytes=4 * 1024 * 1024)
+        _blackbox.install(config={"bench": "observability"}, out_dir=tmp)
+        dt = None
+        try:
+            model.reset()
+            t0 = time.perf_counter()
+            pipe = FetchPipeline(model, consume, depth=8, pack=True)
+            for b in r_batches:
+                pipe.on_batch(b, 0.0)
+                collector.collect()  # worst case: a sideband tick per batch
+            pipe.flush()
+            dt = time.perf_counter() - t0
+        finally:
+            _trace.uninstall()
+            _blackbox.uninstall()
+        return dt
+
+    off_pass(); obs_pass()  # warm both arms' code paths
+
+    from tools.pairedbench import (
+        best_median_rate, paired_ratio_median, run_rounds,
+    )
+
+    times = run_rounds({"off": off_pass, "obs": obs_pass}, budget)
+    out = {
+        "regime": "observability-overhead", "batch": batch,
+        "tweets": n_tweets, "backend": jax.default_backend(),
+        "rounds": len(times["off"]),
+    }
+    for name, ts in times.items():
+        best, median = best_median_rate(ts, n_tweets)
+        out[name] = {
+            "tweets_per_sec_best": best,
+            "tweets_per_sec_median": median,
+        }
+    out["obs"]["paired_vs_off"] = paired_ratio_median(
+        times["off"], times["obs"]
+    )
+    out["neutral"] = out["obs"]["paired_vs_off"] >= 0.98
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -22,8 +22,13 @@ and RECOVER (no sentinel abort, no fetch abort), all three rules fire,
 and row losses show up in counters (rows_lost / rows_dropped_parse /
 rows_shed) — never silently.
 
+On ANY invariant failure the soak collects the crash flight recorder's
+post-mortem bundle (telemetry/blackbox.py — the apps install it per round)
+into ``--artifactDir`` and prints its path, so a CI chaos failure is
+diagnosable after the fact instead of being a dead stdout log.
+
 Usage: python tools/chaos_soak.py [--minutes M] [--tweets N] [--chaos SPEC]
-          [--sourceChaos SPEC] [--sourcePhase on|off]
+          [--sourceChaos SPEC] [--sourcePhase on|off] [--artifactDir DIR]
 Prints one JSON line at the end; exits non-zero on any violated invariant.
 """
 
@@ -62,6 +67,7 @@ def main(argv=None) -> None:
     args = list(sys.argv[1:] if argv is None else argv)
     minutes, n_tweets, chaos = 10.0, 16384, DEFAULT_CHAOS
     source_chaos, source_phase = DEFAULT_SOURCE_CHAOS, True
+    artifact_dir = ""
     i = 0
     while i < len(args):
         if args[i] == "--minutes":
@@ -74,6 +80,8 @@ def main(argv=None) -> None:
             source_chaos = args[i + 1]; i += 2
         elif args[i] == "--sourcePhase":
             source_phase = args[i + 1] == "on"; i += 2
+        elif args[i] == "--artifactDir":
+            artifact_dir = args[i + 1]; i += 2
         else:
             raise SystemExit(f"unknown flag {args[i]!r}")
 
@@ -190,8 +198,24 @@ def main(argv=None) -> None:
             f"{retries} watchdog retries"
         )
 
+    # on any violated invariant, collect the flight recorder's post-mortem
+    # bundle into the artifact dir — aborted rounds already dumped at the
+    # abort funnel; force=True captures the terminal state either way
+    postmortem = ""
+    if failures:
+        from twtml_tpu.telemetry import blackbox as _blackbox
+
+        path = _blackbox.dump(
+            f"chaos-soak invariant failure: {failures[0]}",
+            out_dir=artifact_dir or tmp, force=True,
+        )
+        if path:
+            postmortem = path
+            print(f"chaos-soak post-mortem bundle: {path}", file=sys.stderr)
+
     print(json.dumps({
         "mode": "chaos-soak",
+        "postmortem": postmortem,
         "minutes": round((time.time() - t0) / 60.0, 2),
         "rounds": rounds,
         "tweets": tweets,

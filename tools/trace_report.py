@@ -25,10 +25,21 @@ class MalformedTrace(ValueError):
 
 
 def load_events(path: str) -> list[dict]:
-    """Parse the trace file into event dicts. Tolerates the incremental
-    array decoration (leading ``[``/trailing ``]``, per-line trailing
-    commas) and a plain JSON-array file; raises MalformedTrace on anything
-    that is not a sequence of event objects."""
+    """Parse a trace into event dicts, STITCHING rotated segments: when
+    size rotation (--traceMaxMb) left a ``PATH.1`` next to ``PATH``, its
+    (older) events are prepended so one report covers both segments.
+    Tolerates the incremental array decoration (leading ``[``/trailing
+    ``]``, per-line trailing commas) and a plain JSON-array file; raises
+    MalformedTrace on anything that is not a sequence of event objects."""
+    import os
+
+    rotated = path + ".1"
+    if os.path.exists(rotated):
+        return _load_one(rotated) + _load_one(path)
+    return _load_one(path)
+
+
+def _load_one(path: str) -> list[dict]:
     with open(path, encoding="utf-8") as fh:
         text = fh.read()
     stripped = text.strip()

@@ -15,7 +15,9 @@ from __future__ import annotations
 from ..models.linear import StreamingLinearRegressionWithSGD
 from ..streaming import faults as _faults
 from ..streaming.sources import ReplayFileSource, Source, SyntheticSource
+from ..telemetry import blackbox as _blackbox
 from ..telemetry import metrics as _metrics
+from ..telemetry import sideband as _sideband
 from ..telemetry import trace as _trace
 from ..utils import get_logger
 
@@ -114,7 +116,13 @@ def install_trace(conf) -> None:
 
     if jax.process_count() > 1:
         path = f"{path}.p{jax.process_index()}"
-    _trace.install(path)
+    # size rotation (--traceMaxMb, default 256): a 600 s bench / multi-hour
+    # soak must not grow the JSONL without bound — PATH.1 keeps the
+    # previous segment, trace_report stitches them
+    _trace.install(
+        path,
+        max_bytes=int(getattr(conf, "traceMaxMb", 256) or 0) * 1024 * 1024,
+    )
 
 
 def install_chaos(conf) -> None:
@@ -131,6 +139,36 @@ def install_chaos(conf) -> None:
         _faults.install_chaos(spec)
     except ValueError as exc:
         raise SystemExit(f"bad --chaos spec: {exc}")
+
+
+def install_blackbox(conf) -> None:
+    """``--blackbox`` (default on) wiring shared by every entry point:
+    activate the crash flight recorder (telemetry/blackbox.py). The bundle
+    lands NEXT TO the checkpoint directory — the one place a post-crash
+    operator already looks — or the tempdir when checkpoints are off. A
+    SIGTERM dumps too (kill -TERM mid-soak leaves evidence). Call after
+    ``select_backend`` (the process index may initialize the backend)."""
+    if getattr(conf, "blackbox", "on") != "on":
+        return
+    import os as _os
+    import tempfile as _tempfile
+
+    import jax
+
+    ckpt_dir = getattr(conf, "checkpointDir", "")
+    out_dir = (
+        _os.path.dirname(_os.path.abspath(ckpt_dir))
+        if ckpt_dir else _tempfile.gettempdir()
+    )
+    cfg = {
+        k: v for k, v in vars(conf).items()
+        if not k.startswith("_conf") and isinstance(v, (str, int, float, bool))
+    }
+    cfg["_appName"] = conf.appName()
+    _blackbox.install(
+        config=cfg, out_dir=out_dir, process_index=jax.process_index()
+    )
+    _blackbox.install_signal_handler()
 
 
 def build_source(
@@ -428,6 +466,12 @@ class AppCheckpoint:
             {"count": totals["count"], "batches": totals["batches"]},
         )
         self._last = totals["batches"]
+        # sticky flight-recorder context: a post-mortem bundle names the
+        # checkpoint a restart will resume from (telemetry/blackbox.py)
+        _blackbox.note(
+            "last_checkpoint",
+            {"step": totals["batches"], "count": totals["count"]},
+        )
 
     def maybe_save(self, totals: dict, at_boundary: bool = True) -> None:
         """Cadence save — call per batch from the app's handler."""
@@ -464,6 +508,11 @@ class AppCheckpoint:
         restored = (
             self._ckpt.restore() if self._ckpt is not None else None
         )
+        if restored is not None:
+            _blackbox.note(
+                "last_verified_rollback",
+                {"step": restored[1].get("step")},
+            )
         import jax
 
         if jax.process_count() <= 1:
@@ -622,6 +671,10 @@ class DivergenceSentinel:
             "sentinel_rollback", delivered=self._delivered,
             episode=len(self._rollback_points),
         )
+        _blackbox.record(
+            "sentinel_rollback", delivered=self._delivered,
+            episode=len(self._rollback_points),
+        )
         meta = self._ckpt.rollback_to_verified()
         if meta is not None:
             log.error(
@@ -655,6 +708,10 @@ class DivergenceSentinel:
         ]
         if self.max_rollbacks and len(in_window) >= self.max_rollbacks:
             _metrics.get_registry().counter("model.sentinel_aborts").inc()
+            _blackbox.record(
+                "sentinel_abort", rollbacks=len(in_window),
+                window=self.window,
+            )
             log.critical(
                 "divergence sentinel: %d rollbacks within %d batches — the "
                 "stream keeps poisoning the model; aborting the run "
@@ -851,6 +908,7 @@ class FetchWatchdog:
                 self.aborted = True
                 self._abort_count.inc()
                 _trace.get().instant("fetch_abort", attempts=attempts)
+                _blackbox.record("fetch_abort", attempts=attempts, why=why)
                 log.critical(
                     "pooled stats fetch %s after %d attempt(s); aborting "
                     "the run — the stream stops and the shutdown path "
@@ -863,6 +921,7 @@ class FetchWatchdog:
                     f"pooled fetch {why} after {attempts} attempts"
                 )
             self._retry_count.inc()
+            _blackbox.record("fetch_retry", attempt=attempts, why=why)
             log.warning(
                 "pooled stats fetch %s; re-issuing (retry %d/%d — a "
                 "device_get is an RTT-bound request, a duplicate is safe)",
@@ -1062,6 +1121,7 @@ class SuperBatcher:
         self._fetch_count.inc()
         self._fetch_hist.observe(dt)
         self._health.observe(dt)
+        _sideband.record_stage("fetch", dt)
         tr = _trace.get()
         if tr.enabled:
             tr.complete("fetch", t0, dt, depth=self.fetch_depth,
@@ -1083,6 +1143,7 @@ class SuperBatcher:
         self._fetch_count.inc()
         self._fetch_hist.observe(dt)
         self._health.observe(dt)
+        _sideband.record_stage("fetch", dt)
         tr = _trace.get()
         if tr.enabled:
             tr.complete("fetch", t0, dt, depth=1)
@@ -1158,12 +1219,15 @@ class SuperBatcher:
                             wire = packer(batch)
                     else:
                         wire = packer(batch)
+                import time as _time
+
+                t0 = _time.perf_counter()
                 _faults.perturb("step")  # --chaos dispatch injection
+                out_dev = self.model.step(wire)
+                dt = _time.perf_counter() - t0
+                _sideband.record_stage("dispatch", dt)
                 if tr.enabled:
-                    with tr.span("dispatch"):
-                        out_dev = self.model.step(wire)
-                else:
-                    out_dev = self.model.step(wire)
+                    tr.complete("dispatch", t0, dt)
                 # dispatch-time accounting, as on the grouped path; if the
                 # awaited fetch aborts, the slot is refunded (the batch
                 # trained but was never delivered — cap accounting follows
@@ -1194,14 +1258,17 @@ class SuperBatcher:
         ):
             self._emit_group()
         wire = self._group_wire([b for b, _ in group])
-        _faults.perturb("step")  # --chaos dispatch injection
+        import time as _time
+
         tr = _trace.get()
+        t0 = _time.perf_counter()
+        _faults.perturb("step")  # --chaos dispatch injection
+        outs = self.model.step_many(wire)
+        dt = _time.perf_counter() - t0
+        _sideband.record_stage("dispatch", dt)
         if tr.enabled:
-            with tr.span("dispatch", group=len(group),
-                         depth=len(self._inflight)):
-                outs = self.model.step_many(wire)
-        else:
-            outs = self.model.step_many(wire)
+            tr.complete("dispatch", t0, dt, group=len(group),
+                        depth=len(self._inflight))
         self._inflight.append(
             (self._pool.submit(self._timed_fetch_many, outs, len(group)),
              group, outs)
@@ -1354,6 +1421,7 @@ class FetchPipeline:
         self._fetch_count.inc()
         self._fetch_hist.observe(dt)
         self._health.observe(dt)
+        _sideband.record_stage("fetch", dt)
         tr = _trace.get()
         if tr.enabled:
             tr.complete("fetch", t0, dt, depth=self.depth)
@@ -1410,14 +1478,20 @@ class FetchPipeline:
                 wire = packer(batch)
         else:
             wire = batch
+        # argument uploads ride the dispatch on this transport (no
+        # separate device_put on the single-host hot path); timed
+        # unconditionally for the sideband's upload attribution, with the
+        # --chaos injection INSIDE the window so injected dispatch stalls
+        # attribute like real ones
+        import time as _time
+
+        t0 = _time.perf_counter()
         _faults.perturb("step")  # --chaos dispatch injection
+        out = self.model.step(wire)  # dispatch on the MAIN thread
+        dt = _time.perf_counter() - t0
+        _sideband.record_stage("dispatch", dt)
         if tr.enabled:
-            # argument uploads ride the dispatch on this transport (no
-            # separate device_put on the single-host hot path)
-            with tr.span("dispatch", depth=len(self._pending)):
-                out = self.model.step(wire)  # dispatch on the MAIN thread
-        else:
-            out = self.model.step(wire)  # dispatch on the MAIN thread
+            tr.complete("dispatch", t0, dt, depth=len(self._pending))
         self._pending.append(
             (self._pool.submit(self._timed_fetch, out), out, batch, t)
         )
@@ -1630,12 +1704,13 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
                     wire = packer(batch)
             else:
                 wire = batch
+            td = _time.perf_counter()
             _faults.perturb("step")  # --chaos dispatch injection
+            out = model.step(wire)
+            d_dt = _time.perf_counter() - td
+            _sideband.record_stage("dispatch", d_dt)
             if tr.enabled:
-                with tr.span("dispatch"):
-                    out = model.step(wire)
-            else:
-                out = model.step(wire)
+                tr.complete("dispatch", td, d_dt)
             fetch = getattr(model, "fetch_output", None) or jax.device_get
             t0 = _time.perf_counter()
             _faults.perturb("fetch")
@@ -1645,6 +1720,7 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
             reg.counter("fetch.count").inc()
             reg.histogram("fetch.latency_s").observe(dt)
             _metrics.get_health_monitor().observe(dt)
+            _sideband.record_stage("fetch", dt)
             if tr.enabled:
                 tr.complete("fetch", t0, dt, depth=1)
             handle(out, batch, t, at_boundary=True)

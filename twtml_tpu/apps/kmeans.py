@@ -39,6 +39,7 @@ from .common import (
     build_mesh,
     build_source,
     init_distributed,
+    install_blackbox,
     install_chaos,
     install_trace,
     select_backend,
@@ -100,6 +101,7 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
     select_backend(conf)
     install_trace(conf)
     install_chaos(conf)
+    install_blackbox(conf)  # crash flight recorder (apps/common)
     multihost = jax.process_count() > 1
     if multihost and conf.batchBucket <= 0:
         raise SystemExit(

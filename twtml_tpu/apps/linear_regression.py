@@ -32,6 +32,7 @@ from .common import (  # noqa: F401
     build_model,
     build_source,
     init_distributed,
+    install_blackbox,
     install_chaos,
     install_trace,
     select_backend,
@@ -59,6 +60,9 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     lockstep = jax.process_count() > 1
     install_trace(conf)
     install_chaos(conf)
+    # crash flight recorder: every abort path dumps a post-mortem bundle
+    # next to the checkpoint dir (apps/common.install_blackbox)
+    install_blackbox(conf)
 
     log.info("Initializing streaming context... %s sec/batch", conf.seconds)
     ssc = StreamingContext(

@@ -36,6 +36,7 @@ from .common import (
     build_model,
     build_source,
     init_distributed,
+    install_blackbox,
     install_chaos,
     install_trace,
     select_backend,
@@ -62,6 +63,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     lockstep = jax.process_count() > 1
     install_trace(conf)
     install_chaos(conf)
+    install_blackbox(conf)  # crash flight recorder (apps/common)
 
     ssc = StreamingContext(
         batch_interval=conf.seconds,

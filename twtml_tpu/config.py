@@ -138,6 +138,12 @@ class ConfArguments:
         self.checkpointEvery: int = int(conf.get("checkpointEvery", "0"))
         self.profileDir: str = conf.get("profileDir", "")
         self.trace: str = conf.get("trace", "")
+        self.traceMaxMb: int = int(conf.get("traceMaxMb", "256"))
+        self.blackbox: str = conf.get("blackbox", "on")
+        if self.blackbox not in ("on", "off"):
+            raise ValueError(
+                f"blackbox must be 'on' or 'off', got {self.blackbox!r}"
+            )
         self.faultEvery: int = int(conf.get("faultEvery", "0"))
         self.chaos: str = conf.get("chaos", "")
         self.webTimeout: float = float(conf.get("webTimeout", "2.0"))
@@ -263,6 +269,19 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                                                (source read/parse/featurize/dispatch/fetch/
                                                stats) with wire bytes + health-phase stamps;
                                                summarize with tools/trace_report.py
+  --traceMaxMb <int MB>                        Size-rotate the --trace file: the active
+                                               segment becomes PATH.1 when it crosses this
+                                               size (events falling off the old PATH.1 are
+                                               counted in trace.dropped_events);
+                                               trace_report stitches both segments. 0 =
+                                               unbounded. Default: {self.traceMaxMb}
+  --blackbox <on|off>                          Crash flight recorder: a bounded in-memory
+                                               ring of recent spans/guard events/chaos
+                                               firings/sideband rows, dumped as ONE
+                                               post-mortem JSON bundle next to the
+                                               checkpoint dir on any abort or SIGTERM;
+                                               render with tools/postmortem_report.py.
+                                               Default: {self.blackbox}
   --faultEvery <int tweets>                    Inject a receiver crash every N tweets (chaos testing)
   --chaos <spec>                               Transport chaos injection BELOW the source layer
                                                (testing the runtime guards): comma-separated
@@ -404,6 +423,12 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
             self.profileDir = take()
         elif flag == "--trace":
             self.trace = take()
+        elif flag == "--traceMaxMb":
+            self.traceMaxMb = int(take())
+        elif flag == "--blackbox":
+            self.blackbox = take()
+            if self.blackbox not in ("on", "off"):
+                self.printUsage(1)
         elif flag == "--superBatch":
             self.superBatch = int(take())
         elif flag == "--wirePack":

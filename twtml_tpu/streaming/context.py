@@ -30,6 +30,7 @@ from typing import Callable
 from ..features.batch import FeatureBatch, UnitBatch
 from ..features.featurizer import Featurizer, Status
 from ..telemetry import metrics as _metrics
+from ..telemetry import sideband as _sideband
 from ..telemetry import trace as _trace
 from ..utils import get_logger
 from .sources import Source
@@ -333,10 +334,15 @@ class FeatureStream(RawStream):
         warmup always warms exactly the program the stream will run.
         Instrumented as the ``featurize`` stage (host featurize incl. wire
         build); the span and the ``pipeline.*``/``wire.bytes`` metrics are
-        side-channel only — the batch itself is untouched."""
+        side-channel only — the batch itself is untouched. Timed
+        unconditionally (two clock reads per BATCH) so the per-host
+        sideband's featurize attribution works without ``--trace``."""
         tr = _trace.get()
+        t0 = time.perf_counter()
         if not tr.enabled:
-            return self._poison_gate(statuses, self._featurize_impl(statuses))
+            batch = self._featurize_impl(statuses)
+            _sideband.record_stage("featurize", time.perf_counter() - t0)
+            return self._poison_gate(statuses, batch)
         with tr.span("featurize", items=len(statuses)) as sp:
             batch = self._featurize_impl(statuses)
             from ..features.batch import wire_nbytes
@@ -346,6 +352,7 @@ class FeatureStream(RawStream):
                 valid=batch.num_valid,
                 wire_bytes=wire_nbytes(batch),
             )
+        _sideband.record_stage("featurize", time.perf_counter() - t0)
         return self._poison_gate(statuses, batch)
 
     @staticmethod
@@ -495,13 +502,18 @@ class StreamingContext:
         shape away from its peers') and which makes single-host
         back-to-back block batches deterministic bucket-sized too.
 
-        Instrumented as the ``source_read`` stage when tracing is on."""
+        Instrumented as the ``source_read`` stage when tracing is on; timed
+        unconditionally (per drain, not per item) for the sideband."""
         tr = _trace.get()
+        t0 = time.perf_counter()
         if not tr.enabled:
-            return self._drain_impl(limit)
+            out = self._drain_impl(limit)
+            _sideband.record_stage("source_read", time.perf_counter() - t0)
+            return out
         with tr.span("source_read") as sp:
             out = self._drain_impl(limit)
             sp.add(items=len(out))
+        _sideband.record_stage("source_read", time.perf_counter() - t0)
         return out
 
     def _drain_impl(self, limit: int = 0) -> list[Status]:
@@ -561,12 +573,21 @@ class StreamingContext:
         early-exit hook apps use for max-batches caps."""
         self._stop.set()
 
-    def request_abort(self) -> None:
+    def request_abort(self, reason: str = "runtime guard abort") -> None:
         """Loud-failure hook for the runtime guards (fetch watchdog,
-        lockstep peer watchdog): mark the run failed and stop after the
-        current batch, so the app's shutdown path still flushes its final
-        checkpoint and the process exits non-zero."""
+        divergence sentinel, lockstep peer watchdog, cadence
+        disagreement): mark the run failed and stop after the current
+        batch, so the app's shutdown path still flushes its final
+        checkpoint and the process exits non-zero.
+
+        Every abort path funnels through here, which makes it the crash
+        flight recorder's trigger (telemetry/blackbox.py): the post-mortem
+        bundle dumps ONCE, before the stream winds down — no-op when no
+        recorder is installed."""
         self.failed = True
+        from ..telemetry import blackbox as _blackbox
+
+        _blackbox.abort_dump(reason)
         self.request_stop()
 
     @property
@@ -676,14 +697,28 @@ class StreamingContext:
         Drains are capped at the row bucket in BOTH modes (wall-clock rows
         beyond the bucket stay queued for the next tick): an uncapped drain
         could exceed --batchBucket and grow this host's program shape away
-        from its peers'."""
+        from its peers'.
+
+        **Per-host telemetry sideband (r8)**: the flags array WIDENS to
+        carry each host's fixed sideband vector (telemetry/sideband.py —
+        per-stage wall times, queue depth, fetch-RTT median, shed/rollback
+        counters, health phase) on the SAME allgather: zero added
+        collectives, zero added host fetches (the vector is host-side
+        bookkeeping). Every host then holds the full ``[hosts, W]`` matrix
+        per tick; the straggler attributor (telemetry/straggler.py) names
+        the gating host + stage, and the view feeds the dashboard's
+        ``Hosts`` tiles and the crash flight recorder."""
         import os
 
+        import jax
         import numpy as np
 
         watch_s = float(
             os.environ.get(LOCKSTEP_TIMEOUT_ENV, "")
             or LOCKSTEP_TIMEOUT_DEFAULT_S
+        )
+        tele = _sideband.LockstepTelemetry(
+            jax.process_index(), jax.process_count()
         )
         limit = getattr(self._stream, "row_bucket", 0)
         next_tick = time.monotonic() + self.batch_interval
@@ -716,12 +751,18 @@ class StreamingContext:
                 else 0
             )
             try:
+                # the sideband rides the SAME allgather: flags widen from 4
+                # ints to 4 + sideband.WIDTH floats (int flags are exact in
+                # float64) — never a second collective
                 flags = _watched_allgather(
-                    np.array(
-                        [rows > 0 and not aborting, more and not aborting,
-                         aborting, rollbacks],
-                        dtype=np.int32,
-                    ),
+                    np.concatenate([
+                        np.array(
+                            [rows > 0 and not aborting,
+                             more and not aborting, aborting, rollbacks],
+                            dtype=np.float64,
+                        ),
+                        tele.vector(rollbacks=rollbacks),
+                    ]),
                     watch_s,
                 )
             except Exception:
@@ -734,8 +775,10 @@ class StreamingContext:
                 _metrics.get_registry().counter(
                     "lockstep.watchdog_aborts"
                 ).inc()
-                self.failed = True
+                self.request_abort("lockstep cadence allgather failed "
+                                   "(peer death / transport error)")
                 break
+            tele.tick_done()  # waiting-in-collective ends here
             if flags is None:
                 log.critical(
                     "lockstep peer watchdog: the cadence allgather made no "
@@ -749,16 +792,29 @@ class StreamingContext:
                     "lockstep.watchdog_aborts"
                 ).inc()
                 _trace.get().instant("lockstep_watchdog", timeout_s=watch_s)
-                self.failed = True
+                self.request_abort(
+                    f"lockstep peer watchdog: no allgather progress in "
+                    f"{watch_s:.0f}s"
+                )
                 break
-            if flags[:, 2].any():
+            # single-process gathers come back without the process axis
+            flags = np.atleast_2d(np.asarray(flags))
+            fi = flags[:, :4].astype(np.int64)  # the lockstep decisions
+            if flags.shape[1] > 4:
+                # per-host sideband matrix: straggler attribution + the
+                # hosts[] view (pure host-side bookkeeping)
+                tele.ingest(flags[:, 4:].astype(np.float64))
+            if fi[:, 2].any():
                 # this host (or a peer) aborted: everyone has now agreed on
                 # it in the same tick, so everyone can stop dispatching
                 if not aborting:
                     log.critical("a peer host aborted the lockstep run")
-                self.failed = True
+                self.request_abort(
+                    "lockstep batch failure on this host"
+                    if aborting else "a peer host aborted the lockstep run"
+                )
                 break
-            if flags.shape[1] > 3 and len(set(flags[:, 3].tolist())) > 1:
+            if len(set(fi[:, 3].tolist())) > 1:
                 # sentinel rollbacks must land on the SAME step on every
                 # host (global stats + deterministic deliveries guarantee
                 # it); disagreement means the hosts' model states have
@@ -766,14 +822,17 @@ class StreamingContext:
                 log.critical(
                     "lockstep hosts disagree on sentinel rollback counts "
                     "%s — model states have diverged; aborting the group",
-                    flags[:, 3].tolist(),
+                    fi[:, 3].tolist(),
                 )
                 _metrics.get_registry().counter(
                     "lockstep.rollback_disagreements"
                 ).inc()
-                self.failed = True
+                self.request_abort(
+                    "lockstep hosts disagree on sentinel rollback counts "
+                    f"{fi[:, 3].tolist()}"
+                )
                 break
-            if flags[:, 0].any():
+            if fi[:, 0].any():
                 # somebody has rows: EVERY host dispatches (local may be
                 # empty — it pads to the pinned bucket)
                 try:
@@ -786,7 +845,7 @@ class StreamingContext:
                         exc_info=True,
                     )
                     aborting = True  # next tick broadcasts abort to peers
-            if not aborting and not (flags[:, 0].any() or flags[:, 1].any()):
+            if not aborting and not (fi[:, 0].any() or fi[:, 1].any()):
                 break
         self._terminated.set()
 

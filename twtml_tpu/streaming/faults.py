@@ -206,12 +206,19 @@ class ChaosInjector:
             fired = [r for r in rules if r.fires(n, self._rng)]
         if not fired:
             return
+        from ..telemetry import blackbox as _blackbox
         from ..telemetry import metrics as _metrics
 
         reg = _metrics.get_registry()
         raise_after = False
         for r in fired:
             reg.counter("chaos.injected").inc()
+            # flight-recorder ring: a post-mortem over a chaos run must
+            # show which rules fired on the way down (no-op when no
+            # recorder is installed)
+            _blackbox.record(
+                "chaos", target=target, action=r.kind, call=n,
+            )
             if r.kind == "delay":
                 reg.counter(f"chaos.{target}.delays").inc()
                 log.warning(
@@ -240,6 +247,7 @@ class ChaosInjector:
             fired = [r for r in rules if r.fires(n, self._rng)]
         if not fired:
             return None
+        from ..telemetry import blackbox as _blackbox
         from ..telemetry import metrics as _metrics
 
         reg = _metrics.get_registry()
@@ -247,6 +255,7 @@ class ChaosInjector:
         for r in fired:
             reg.counter("chaos.injected").inc()
             reg.counter(f"chaos.{target}.injected").inc()
+            _blackbox.record("chaos", target=target, action="inject", call=n)
             value = max(value, r.value)
         return value
 

@@ -28,6 +28,7 @@ import time
 from typing import Callable, Iterator
 
 from ..features.featurizer import Status
+from ..telemetry import sideband as _sideband
 from ..utils import get_logger
 
 log = get_logger("streaming.sources")
@@ -227,8 +228,14 @@ class ReplayFileSource(Source):
                                 "parse", time.perf_counter() - t_parse,
                                 t_parse, items=n_parse,
                             )
+                            _sideband.record_stage("parse", t_parse)
                             t_parse, n_parse = 0.0, 0
                     else:
+                        # per-line timing stays trace-gated: two clock
+                        # reads per tweet would tax the ~1.2M tweets/s
+                        # parser — the sideband's parse attribution on
+                        # OBJECT ingest therefore needs --trace (the block
+                        # parser below always contributes)
                         status = Status.from_json(json.loads(line))
                     if self.speed > 0:
                         gap_ms = 10.0
@@ -243,6 +250,7 @@ class ReplayFileSource(Source):
                     "parse", time.perf_counter() - t_parse, t_parse,
                     items=n_parse,
                 )
+                _sideband.record_stage("parse", t_parse)
             if not self.loop:
                 return
 
@@ -280,12 +288,16 @@ class BlockParserMixin:
         from ..telemetry import trace as _trace
 
         tr = _trace.get()
+        t0 = time.perf_counter()
         if not tr.enabled:
-            return self._parse_impl(data)
+            out = self._parse_impl(data)
+            _sideband.record_stage("parse", time.perf_counter() - t0)
+            return out
         with tr.span("parse", bytes=len(data)) as sp:
             block, rest = self._parse_impl(data)
             if block is not None:
                 sp.add(rows=int(block.rows))
+        _sideband.record_stage("parse", time.perf_counter() - t0)
         return block, rest
 
     def _parse_impl(self, data: bytes):

@@ -1,9 +1,10 @@
-from .api_types import Config, Metrics, Series, Stats, decode, encode
+from .api_types import Config, Hosts, Metrics, Series, Stats, decode, encode
 from .web_client import WebClient
 from .session_stats import SessionStats
-from . import metrics, trace
+from . import blackbox, metrics, sideband, straggler, trace
 
 __all__ = [
-    "Config", "Metrics", "Series", "Stats", "decode", "encode",
-    "WebClient", "SessionStats", "metrics", "trace",
+    "Config", "Hosts", "Metrics", "Series", "Stats", "decode", "encode",
+    "WebClient", "SessionStats", "blackbox", "metrics", "sideband",
+    "straggler", "trace",
 ]

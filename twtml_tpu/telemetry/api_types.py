@@ -60,17 +60,37 @@ class Metrics:
     Rides the jsonClass-discriminated wire like Series, so legacy dashboards
     ignore it. ``counters``/``gauges`` are flat name→value maps; ``health``
     is TunnelHealthMonitor.summary() (phase, rtt_ms, transitions,
-    observations)."""
+    observations); ``histograms`` (r8) maps name → derived
+    count/mean/p50/p95/p99 (the latency tile — raw buckets stay
+    registry-side)."""
 
     counters: dict = field(default_factory=dict)
     gauges: dict = field(default_factory=dict)
     health: dict = field(default_factory=dict)
+    histograms: dict = field(default_factory=dict)
 
     json_class = "Metrics"
 
 
+@dataclass
+class Hosts:
+    """Per-host lockstep telemetry view — an ADDITIVE message type (no
+    reference equivalent; the reference is single-process). One row per
+    host from the sideband matrix that rides the cadence allgather
+    (telemetry/sideband.py), plus the straggler attributor's verdict:
+    which host gated this tick, which bottleneck-ladder stage, and the
+    tick skew. Legacy dashboards ignore it like Series/Metrics."""
+
+    hosts: list = field(default_factory=list)
+    straggler: int = -1
+    stage: str = ""
+    skewMs: float = 0.0
+
+    json_class = "Hosts"
+
+
 TYPES = {"Config": Config, "Stats": Stats, "Series": Series,
-         "Metrics": Metrics}
+         "Metrics": Metrics, "Hosts": Hosts}
 
 
 def encode(obj: Config | Stats) -> str:

@@ -112,17 +112,20 @@ class Histogram:
         """Approximate p-quantile (0..1) from the bucket counts; the bucket's
         upper bound is the estimate (conservative for latencies)."""
         with self._lock:
-            if self.count == 0:
-                return 0.0
-            target = p * self.count
-            seen = 0
-            for i, c in enumerate(self.counts):
-                seen += c
-                if seen >= target:
-                    if i >= len(self.bounds):
-                        return float(self.max)
-                    return self.bounds[i]
-            return float(self.max)
+            return self._percentile_locked(p)
+
+    def _percentile_locked(self, p: float) -> float:
+        if self.count == 0:
+            return 0.0
+        target = p * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target:
+                if i >= len(self.bounds):
+                    return float(self.max)
+                return self.bounds[i]
+        return float(self.max)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -132,6 +135,13 @@ class Histogram:
                 "min": self.min,
                 "max": self.max,
                 "mean": (self.sum / self.count) if self.count else 0.0,
+                # derived percentiles (r8): /api/metrics consumers and the
+                # dashboard latency tile want p50/p95/p99 without
+                # re-implementing the bucket walk client-side; identical to
+                # Histogram.percentile by construction (one shared walk)
+                "p50": self._percentile_locked(0.50),
+                "p95": self._percentile_locked(0.95),
+                "p99": self._percentile_locked(0.99),
                 "buckets": [
                     [b, c] for b, c in zip(self.bounds, self.counts) if c
                 ] + ([["inf", self.counts[-1]]] if self.counts[-1] else []),
@@ -273,6 +283,13 @@ class TunnelHealthMonitor:
         from . import trace as _trace
 
         _trace.get().instant(
+            "health_phase", phase=phase, latency_ms=round(latency_s * 1e3, 3)
+        )
+        # flight-recorder ring (no-op unless a recorder is installed): a
+        # phase flip is exactly the context a post-mortem wants
+        from . import blackbox as _blackbox
+
+        _blackbox.record(
             "health_phase", phase=phase, latency_ms=round(latency_s * 1e3, 3)
         )
 

@@ -22,6 +22,7 @@ import numpy as np
 
 from ..utils import get_logger, round_half_up
 from . import metrics as _metrics
+from . import sideband as _sideband
 from . import trace as _trace
 from .breaker import CircuitBreaker
 from .lightning import CHART_MAX_POINTS, Lightning, Visualization
@@ -104,13 +105,21 @@ class SessionStats:
     ) -> None:
         """Push one batch of stats — same call shape as SessionStats.update
         (SessionStats.scala:22-34); mse/stdevs arrive already HALF_UP-rounded
-        and are truncated to int for the dashboard like ``.toLong``."""
+        and are truncated to int for the dashboard like ``.toLong``. Timed
+        unconditionally (per batch) for the sideband's publish stage."""
+        import time as _time
+
         tr = _trace.get()
+        t0 = _time.perf_counter()
         if not tr.enabled:
             self._update(count, batch, mse, real_stdev, pred_stdev, real, pred)
+            _sideband.record_stage(
+                "stats_publish", _time.perf_counter() - t0
+            )
             return
         with tr.span("stats_publish", batch=int(batch)):
             self._update(count, batch, mse, real_stdev, pred_stdev, real, pred)
+        _sideband.record_stage("stats_publish", _time.perf_counter() - t0)
 
     def _series_due(self) -> bool:
         """Degraded-tunnel load shedding: the per-batch series frame is the
@@ -171,16 +180,40 @@ class SessionStats:
 
     def publish_metrics(self) -> None:
         """Best-effort push of the process metrics registry + tunnel-health
-        summary to the dashboard's observability panel (/api/metrics)."""
+        summary to the dashboard's observability panel (/api/metrics) —
+        with derived per-histogram p50/p95/p99 (the latency tile), and the
+        per-host ``Hosts`` view when a lockstep sideband is live."""
         if not self._web_breaker.allow():
             return
         try:
             snap = _metrics.get_registry().snapshot()
+            # ship the derived quantiles, not the raw buckets: the
+            # dashboard tile wants three numbers per histogram, and the
+            # wire stays small
+            hists = {
+                name: {
+                    k: h[k] for k in ("count", "mean", "p50", "p95", "p99")
+                }
+                for name, h in snap["histograms"].items()
+            }
             self.web.metrics(
                 snap["counters"], snap["gauges"],
                 _metrics.get_health_monitor().summary(),
+                histograms=hists,
             )
             self._web_breaker.record_success()
         except Exception:
             self._web_breaker.record_failure()
             log.debug("web.metrics failed", exc_info=True)
+        view = _sideband.last_hosts()
+        if view is None or not self._web_breaker.allow():
+            return
+        try:
+            self.web.hosts(
+                view["hosts"], view["straggler"], view["stage"],
+                view["skew_ms"],
+            )
+            self._web_breaker.record_success()
+        except Exception:
+            self._web_breaker.record_failure()
+            log.debug("web.hosts failed", exc_info=True)

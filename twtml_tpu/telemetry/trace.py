@@ -25,6 +25,19 @@ any argument computation.
 Threading: spans are written from the main thread AND the fetch pool
 (apps/common.FetchPipeline) — one lock around the line write keeps events
 intact; ``tid`` records the emitting thread so Perfetto lanes stay honest.
+
+Growth cap (r8): a ``--trace`` file grows without bound over a 600 s bench
+or a multi-hour soak, so the writer rotates on size — when the active file
+crosses ``max_bytes`` it becomes ``PATH.1`` (replacing any previous
+``PATH.1``, whose events are the DROPPED ones — counted in the
+``trace.dropped_events`` registry counter) and a fresh ``PATH`` segment
+starts. ``tools/trace_report.py`` stitches ``PATH.1`` + ``PATH`` back into
+one report. ``--traceMaxMb 0`` disables rotation.
+
+Event sink (r8): the crash flight recorder (telemetry/blackbox.py) attaches
+via ``set_event_sink`` so recent spans ride its bounded in-memory ring —
+one callback per written event, no second file, nothing when tracing is
+off.
 """
 
 from __future__ import annotations
@@ -126,18 +139,26 @@ class _Span:
 class PipelineTrace:
     """Chrome-trace-event writer. ``ts`` is ``time.perf_counter`` µs (one
     monotonic timebase across threads); writes are line-buffered so a crash
-    loses at most the event being formatted."""
+    loses at most the event being formatted. ``max_bytes`` arms size-based
+    rotation (module docstring; 0 = unbounded)."""
 
     enabled = True
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, max_bytes: int = 0):
         self.path = path
+        self.max_bytes = max(0, int(max_bytes))
         self._lock = threading.Lock()
         self._pid = os.getpid()
+        self._bytes = 0
+        self._events_in_file = 0
+        self._rotated_events = 0  # events in OUR current PATH.1 segment
         # buffering=1: every event line reaches the OS immediately — the
         # crash-flush guarantee without an explicit flush per event
         self._fh = open(path, "w", encoding="utf-8", buffering=1)
         self._fh.write("[\n")
+        self._write_meta()
+
+    def _write_meta(self) -> None:
         self._event(
             {"name": "process_name", "ph": "M", "pid": self._pid, "tid": 0,
              "args": {"name": "twtml-tpu pipeline"}}
@@ -150,6 +171,47 @@ class PipelineTrace:
             if self._fh.closed:
                 return
             self._fh.write(line + ",\n")
+            self._bytes += len(line) + 2
+            self._events_in_file += 1
+            if self.max_bytes and self._bytes >= self.max_bytes:
+                self._rotate_locked()
+        sink = _SINK
+        if sink is not None:
+            try:
+                sink(ev)
+            except Exception:  # a sick sink must never kill the pipeline
+                log.debug("trace event sink failed", exc_info=True)
+
+    def _rotate_locked(self) -> None:
+        """Size rotation (caller holds the lock): the active segment becomes
+        PATH.1; a previous PATH.1's events fall off the end and are counted
+        as dropped — the bounded two-segment policy keeps worst-case disk
+        at ~2 x max_bytes for arbitrarily long runs."""
+        self._fh.close()
+        rotated = self.path + ".1"
+        if self._rotated_events:
+            from . import metrics as _metrics
+
+            _metrics.get_registry().counter("trace.dropped_events").inc(
+                self._rotated_events
+            )
+            log.warning(
+                "trace rotation dropped %d event(s) from the oldest "
+                "segment (%s)", self._rotated_events, rotated,
+            )
+        os.replace(self.path, rotated)
+        self._rotated_events = self._events_in_file
+        self._bytes = 0
+        self._events_in_file = 0
+        self._fh = open(self.path, "w", encoding="utf-8", buffering=1)
+        self._fh.write("[\n")
+        # re-emit the metadata so the fresh segment stands alone in Perfetto
+        meta = {"name": "process_name", "ph": "M", "pid": self._pid,
+                "tid": 0, "args": {"name": "twtml-tpu pipeline"}}
+        line = json.dumps(meta, separators=(",", ":"))
+        self._fh.write(line + ",\n")
+        self._bytes += len(line) + 2
+        self._events_in_file += 1
 
     def _base(self, name: str) -> dict:
         return {
@@ -209,17 +271,29 @@ class PipelineTrace:
 
 _active: "PipelineTrace | _NullTrace" = _NULL
 
+# optional per-event callback (the flight recorder's ring — blackbox.py);
+# one attribute read per written event, None when nothing listens
+_SINK = None
 
-def install(path: str) -> "PipelineTrace | _NullTrace":
+
+def set_event_sink(sink) -> None:
+    """Attach/detach the per-event callback (``None`` detaches). Events
+    only flow while a real tracer is installed — the sink never turns
+    tracing on by itself."""
+    global _SINK
+    _SINK = sink
+
+
+def install(path: str, max_bytes: int = 0) -> "PipelineTrace | _NullTrace":
     """Activate tracing to ``path`` (empty path → stays off). Closes any
     previously installed tracer; registered atexit so a crash still flushes
-    and closes the file."""
+    and closes the file. ``max_bytes`` arms size rotation (0 = off)."""
     global _active
     if not path:
         return _active
     if _active.enabled:
         _active.close()
-    _active = PipelineTrace(path)
+    _active = PipelineTrace(path, max_bytes=max_bytes)
     atexit.register(_active.close)
     log.info("pipeline trace → %s (Perfetto-loadable)", path)
     return _active

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import urllib.request
 
-from .api_types import Config, Metrics, Series, Stats, decode, encode
+from .api_types import Config, Hosts, Metrics, Series, Stats, decode, encode
 
 DEFAULT_SERVER = "http://localhost:8888"  # WebClient.scala:13
 
@@ -72,11 +72,21 @@ class WebClient:
             )
         )
 
-    def metrics(self, counters: dict, gauges: dict, health: dict) -> None:
+    def metrics(self, counters: dict, gauges: dict, health: dict,
+                histograms: "dict | None" = None) -> None:
         """Push a pipeline-metrics snapshot for the dashboard's
-        observability panel (additive message; telemetry/metrics.py)."""
+        observability panel (additive message; telemetry/metrics.py).
+        ``histograms`` carries the derived p50/p95/p99 per histogram."""
         self._post(Metrics(counters=dict(counters), gauges=dict(gauges),
-                           health=dict(health)))
+                           health=dict(health),
+                           histograms=dict(histograms or {})))
+
+    def hosts(self, hosts: list, straggler: int = -1, stage: str = "",
+              skew_ms: float = 0.0) -> None:
+        """Push the per-host lockstep sideband view for the dashboard's
+        Hosts tile row (additive message; telemetry/sideband.py)."""
+        self._post(Hosts(hosts=list(hosts), straggler=int(straggler),
+                         stage=str(stage), skewMs=float(skew_ms)))
 
     # -- reads (WebClient.scala:40-46) ---------------------------------------
     def get_config(self) -> Config:

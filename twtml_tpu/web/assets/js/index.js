@@ -58,6 +58,40 @@
     const rb = document.getElementById("rollbacks");
     rb.textContent = String(counters["model.rollbacks"] || 0);
     rb.classList.toggle("degraded", (counters["model.rollbacks"] || 0) > 0);
+    // derived latency quantiles (Histogram.snapshot p95, seconds → ms)
+    const hist = (json.histograms || {})["fetch.latency_s"] || {};
+    document.getElementById("fetchP95").textContent =
+      (Number(hist.p95 || 0) * 1000).toFixed(1);
+  }
+
+  function onHosts(json) {
+    // per-host lockstep tiles (telemetry/sideband.py): one tile per host,
+    // the straggler attributor's pick highlighted with its ladder stage
+    const straggler = document.getElementById("straggler");
+    const gating = Number(json.straggler) >= 0;
+    straggler.textContent = gating
+      ? "host " + json.straggler + (json.stage ? " · " + json.stage : "")
+      : "—";
+    straggler.classList.toggle("degraded", gating);
+    document.getElementById("tickSkew").textContent =
+      String(json.skewMs || 0);
+    const panel = document.getElementById("hostsPanel");
+    panel.replaceChildren();
+    for (const h of json.hosts || []) {
+      const tile = document.createElement("div");
+      tile.className = "stat";
+      const isGating = gating && h.host === json.straggler;
+      if (isGating) tile.classList.add("gating");
+      const label = document.createElement("div");
+      label.className = "label";
+      label.textContent = "host " + h.host + (isGating ? " · gating" : "");
+      const value = document.createElement("div");
+      value.className = "value";
+      value.textContent = Number(h.tick_prep_ms || 0).toFixed(0) + " ms";
+      tile.appendChild(label);
+      tile.appendChild(value);
+      panel.appendChild(tile);
+    }
   }
 
   function onMessage(json) {
@@ -65,6 +99,7 @@
       case "Config": onConfig(json); break;
       case "Stats": onStats(json); break;
       case "Metrics": onMetrics(json); break;
+      case "Hosts": onHosts(json); break;
       case "Series":
         // live frames buffer until the history backfill lands (ordering)
         if (!backfilled) pendingSeries.push(json);
@@ -87,6 +122,8 @@
     api.getStats().then(onStats).catch(() => {});
     // observability panel backfill (latest Metrics snapshot, if any)
     fetch("/api/metrics").then((r) => r.json()).then(onMetrics).catch(() => {});
+    // per-host lockstep view backfill (empty hosts[] on single-host runs)
+    fetch("/api/hosts").then((r) => r.json()).then(onHosts).catch(() => {});
     // backfill the chart from the server's rolling series window, then
     // apply any live frames that arrived while the fetch was in flight
     const flush = () => {

@@ -16,7 +16,9 @@ import tempfile
 import collections
 import json as _json
 
-from ..telemetry.api_types import Config, Metrics, Series, Stats, decode, encode
+from ..telemetry.api_types import (
+    Config, Hosts, Metrics, Series, Stats, decode, encode,
+)
 from ..utils import get_logger
 
 log = get_logger("web.cache")
@@ -33,6 +35,7 @@ class ApiCache:
         self._stats = Stats()
         self._config = Config()
         self._metrics = Metrics()
+        self._hosts = Hosts()
         self._series: collections.deque[Series] = collections.deque(
             maxlen=SERIES_WINDOW
         )
@@ -46,6 +49,10 @@ class ApiCache:
     def metrics(self) -> str:
         """Latest pipeline-metrics snapshot (in-memory only, like Stats)."""
         return encode(self._metrics)
+
+    def hosts(self) -> str:
+        """Latest per-host lockstep sideband view (in-memory only)."""
+        return encode(self._hosts)
 
     def series(self) -> str:
         """Recent Series messages as a JSON array (chart backfill for
@@ -71,6 +78,8 @@ class ApiCache:
             self._stats = data
         elif isinstance(data, Metrics):
             self._metrics = data
+        elif isinstance(data, Hosts):
+            self._hosts = data
         elif isinstance(data, Series):
             self._series.append(data)
         else:

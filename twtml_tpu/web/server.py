@@ -73,6 +73,9 @@ class Server:
     async def _get_metrics(self, request: web.Request) -> web.StreamResponse:
         return web.Response(text=self.cache.metrics(), content_type="application/json")
 
+    async def _get_hosts(self, request: web.Request) -> web.StreamResponse:
+        return web.Response(text=self.cache.hosts(), content_type="application/json")
+
     async def _ws_api(self, request: web.Request) -> web.StreamResponse:
         ws = web.WebSocketResponse(heartbeat=30)
         await ws.prepare(request)
@@ -161,6 +164,7 @@ class Server:
         app.router.add_get("/api/stats", self._get_stats)
         app.router.add_get("/api/series", self._get_series)  # chart backfill
         app.router.add_get("/api/metrics", self._get_metrics)  # observability
+        app.router.add_get("/api/hosts", self._get_hosts)  # lockstep fleet view
         app.router.add_get("/", self._index)
         app.router.add_get("/{path:.+}", self._static)
         return app
