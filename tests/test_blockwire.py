@@ -1,0 +1,600 @@
+"""Zero-copy wire emitter (native/tweetjson.cpp parse_tweet_block_wire).
+
+The wire parser emits the ragged wire's unit representation straight from
+raw block bytes — uint8 units when every kept row is ASCII, uint16
+otherwise. The parity law: every array it emits (units, offsets, numeric,
+labels, ascii flags) must be byte-identical to BOTH the legacy C block
+parser and the Python object path (json.loads → Status → featurize), across
+the adversarial sweep below. The stale-library seam must degrade loudly to
+the ParsedBlock path — never a ctypes AttributeError mid-stream.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from twtml_tpu.features import Featurizer, Status, native
+from twtml_tpu.features.blocks import merge_blocks
+from twtml_tpu.streaming.sources import BlockReplayFileSource
+
+DATA = os.path.join(os.path.dirname(__file__), "data", "tweets.jsonl")
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+def _blocks(path, wire, **kw):
+    src = BlockReplayFileSource(str(path), wire=wire, **kw)
+    return list(src.produce())
+
+
+def _merged(path, wire, **kw):
+    return merge_blocks(_blocks(path, wire, **kw))
+
+
+def _object_batch(path, feat, **kw):
+    with open(path, encoding="utf-8") as fh:
+        statuses = [Status.from_json(json.loads(l)) for l in fh if l.strip()]
+    return feat.featurize_batch_ragged(statuses, **kw)
+
+
+def _assert_block_parity(legacy, wire):
+    """Wire-parsed block == legacy block (units compared as code units —
+    the wire block may carry them uint8)."""
+    np.testing.assert_array_equal(legacy.numeric, wire.numeric)
+    np.testing.assert_array_equal(legacy.offsets, wire.offsets)
+    np.testing.assert_array_equal(legacy.ascii, wire.ascii)
+    np.testing.assert_array_equal(
+        legacy.units.astype(np.uint16), wire.units.astype(np.uint16)
+    )
+    # the narrow dtype IS the ascii metadata: uint8 iff every row ASCII
+    if wire.rows:
+        assert (wire.units.dtype == np.uint8) == bool(wire.ascii.all())
+
+
+def _assert_ragged_equal(a, b):
+    for f in ("units", "offsets", "numeric", "label", "mask"):
+        np.testing.assert_array_equal(getattr(a, f), getattr(b, f))
+        assert getattr(a, f).dtype == getattr(b, f).dtype
+    assert a.row_len == b.row_len
+
+
+def _write(tmp_path, objs, name="tweets.jsonl", ensure_ascii=True):
+    path = tmp_path / name
+    path.write_text(
+        "\n".join(json.dumps(o, ensure_ascii=ensure_ascii) for o in objs)
+        + "\n",
+        encoding="utf-8",
+    )
+    return path
+
+
+def _rt(text, count=500, **extra):
+    rt = {"text": text, "retweet_count": count,
+          "user": {"followers_count": 1, "favourites_count": 2,
+                   "friends_count": 3},
+          "timestamp_ms": "1785313333333"}
+    rt.update(extra)
+    return {"text": "RT", "retweeted_status": rt}
+
+
+@pytest.fixture()
+def feat():
+    return Featurizer(now_ms=1785320000000)
+
+
+# ---------------------------------------------------------------------------
+# block-level parity: wire emitter vs legacy C parser vs object path
+
+
+def test_fixture_parity_all_three_paths(feat):
+    legacy = _merged(DATA, wire=False)
+    wire = _merged(DATA, wire=True)
+    _assert_block_parity(legacy, wire)
+    obj = _object_batch(DATA, feat, row_bucket=16, unit_bucket=128)
+    blk = feat.featurize_parsed_block(
+        wire, row_bucket=16, unit_bucket=128, ragged=True
+    )
+    _assert_ragged_equal(obj, blk)
+
+
+def test_ascii_corpus_is_narrow(feat, tmp_path):
+    path = _write(tmp_path, [_rt(f"plain ascii tweet {i}") for i in range(64)])
+    wire = _merged(path, wire=True)
+    assert wire.units.dtype == np.uint8 and wire.rows == 64
+    _assert_block_parity(_merged(path, wire=False), wire)
+    # the ragged batch ships the SAME narrow dtype the legacy path would
+    # have downcast to — bit-identical wire
+    obj = _object_batch(str(path), feat, row_bucket=64, unit_bucket=64)
+    blk = feat.featurize_parsed_block(
+        wire, row_bucket=64, unit_bucket=64, ragged=True
+    )
+    assert blk.units.dtype == np.uint8
+    _assert_ragged_equal(obj, blk)
+
+
+@pytest.mark.parametrize("ensure_ascii", [True, False])
+def test_non_ascii_widens_and_matches(feat, tmp_path, ensure_ascii):
+    """Folds, é/İ (length-changing lower), CJK, raw + escaped surrogate
+    pairs: the emitter widens mid-block and stays byte-identical."""
+    objs = (
+        [_rt(f"ascii prefix {i}") for i in range(5)]
+        + [_rt("Ünïcödé ROW é"), _rt("İstanbul ẞharp"), _rt("火 🔥 emoji"),
+           _rt("pair \U0001f600 astral")]
+        + [_rt(f"ascii suffix {i}") for i in range(5)]
+    )
+    path = _write(tmp_path, objs, ensure_ascii=ensure_ascii)
+    legacy = _merged(path, wire=False)
+    wire = _merged(path, wire=True)
+    assert wire.units.dtype == np.uint16  # widened
+    _assert_block_parity(legacy, wire)
+    obj = _object_batch(str(path), feat, row_bucket=16, unit_bucket=64)
+    blk = feat.featurize_parsed_block(
+        wire, row_bucket=16, unit_bucket=64, ragged=True
+    )
+    _assert_ragged_equal(obj, blk)
+
+
+def test_escaped_surrogate_pairs_and_lone_surrogates(tmp_path):
+    """\\uD83D\\uDE00 pairs and lone halves pass through as units, exactly
+    like the legacy parser and the JVM view."""
+    lines = [
+        json.dumps(_rt("emoji")),
+        # escaped pair + escaped lone surrogate, raw control escapes
+        '{"text": "RT", "retweeted_status": {"text": '
+        '"a\\ud83d\\ude00b\\ud800c\\n\\t", "retweet_count": 500, '
+        '"user": {"followers_count": 1}}}',
+    ]
+    path = tmp_path / "sur.jsonl"
+    path.write_text("\n".join(lines) + "\n", encoding="utf-8")
+    legacy = _merged(path, wire=False)
+    wire = _merged(path, wire=True)
+    assert wire.rows == 2
+    _assert_block_parity(legacy, wire)
+    u = wire.units.astype(np.uint16)
+    assert (u == 0xD83D).sum() == 1  # pair high half, kept as a half
+    assert (u == 0xD800).sum() == 1  # lone half, kept as-is
+
+
+def test_empty_text_and_full_text_fallback(feat, tmp_path):
+    objs = [
+        _rt(""),  # empty body
+        {"text": "RT", "retweeted_status": {
+            "full_text": "extended body only", "retweet_count": 400,
+            "user": {"followers_count": 2}}},
+        {"text": "RT", "retweeted_status": {
+            "text": "", "full_text": "fallback body", "retweet_count": 500,
+            "user": {"followers_count": 1}}},
+        {"text": "RT", "retweeted_status": {
+            "text": "short wins", "full_text": "long form",
+            "retweet_count": 600, "user": {"followers_count": 1}}},
+    ]
+    path = _write(tmp_path, objs)
+    legacy = _merged(path, wire=False)
+    wire = _merged(path, wire=True)
+    assert wire.rows == 4
+    _assert_block_parity(legacy, wire)
+
+
+def test_oversized_text_drops_line_wire_path(tmp_path):
+    """The kMaxTextUnits wire bound: over-bound texts (text OR full_text,
+    any duplicate occurrence) drop the line; exactly-at-bound rows keep."""
+    from twtml_tpu.features.native import MAX_TEXT_UNITS
+
+    over = _rt("a" * (MAX_TEXT_UNITS + 1))
+    over_full = {"text": "RT", "retweeted_status": {
+        "text": "tiny", "full_text": "b" * (MAX_TEXT_UNITS + 100),
+        "retweet_count": 500, "user": {"followers_count": 1}}}
+    at_bound = _rt("c" * MAX_TEXT_UNITS)
+    path = _write(tmp_path, [_rt("ok"), over, over_full, at_bound, _rt("ok2")])
+    legacy = _merged(path, wire=False)
+    wire = _merged(path, wire=True)
+    assert wire.rows == legacy.rows == 3
+    _assert_block_parity(legacy, wire)
+    assert int(np.diff(wire.offsets).max()) == MAX_TEXT_UNITS
+
+
+def test_single_tweet_and_all_padding_blocks(feat, tmp_path):
+    # single kept tweet
+    one = _write(tmp_path, [_rt("only one")], name="one.jsonl")
+    wire = _merged(one, wire=True)
+    assert wire.rows == 1
+    _assert_block_parity(_merged(one, wire=False), wire)
+    batch = feat.featurize_parsed_block(
+        wire, row_bucket=8, unit_bucket=32, ragged=True
+    )
+    assert batch.num_valid == 1
+    # nothing passes the filter -> blocks with zero rows are never yielded,
+    # and the empty featurize (warmup twin) still matches shapes
+    none = _write(
+        tmp_path,
+        [{"text": "plain, not a retweet", "retweet_count": 5}],
+        name="none.jsonl",
+    )
+    assert _blocks(none, wire=True) == []
+    warm = feat.featurize_batch_ragged([], row_bucket=8, unit_bucket=32)
+    import jax
+
+    assert jax.tree_util.tree_structure(warm) == jax.tree_util.tree_structure(
+        batch
+    )
+
+
+def test_row_over_uint16_units_takes_int32_offset_wire(feat):
+    """The PR 3 gating rule on the new path: a block whose rebuilt row
+    length exceeds 65,535 units cannot ship uint16 length deltas — the
+    packed ragged wire falls back to int32 offsets, bit-identically.
+
+    The C parser bounds rows at 4096 units, so a >65,535-unit row is
+    hand-built (the gate is static in row_len, not sniffed from data)."""
+    from twtml_tpu.features.batch import (
+        offsets_narrow,
+        pack_batch,
+        unpack_batch,
+    )
+    from twtml_tpu.features.blocks import ParsedBlock
+
+    n_units = (1 << 16) + 10
+    block = ParsedBlock(
+        np.array([[500, 1, 2, 3, 1785313333333]], np.int64),
+        np.full((n_units,), ord("x"), np.uint16),
+        np.array([0, n_units], np.int64),
+        np.array([1], np.uint8),
+    )
+    rb = feat.featurize_parsed_block(
+        block, row_bucket=8, unit_bucket=1 << 17, ragged=True
+    )
+    assert rb.row_len == 1 << 17 and not offsets_narrow(rb.row_len)
+    packed = pack_batch(rb)
+    assert packed.layout[2][2] == "i32"  # int32 offset wire, not u16 deltas
+    back = unpack_batch(packed.buffer, packed.layout)
+    for f in ("units", "offsets", "numeric", "label", "mask"):
+        np.testing.assert_array_equal(getattr(rb, f), getattr(back, f))
+    # and a normal wire-parsed block stays on the narrow delta wire
+    small = _merged(DATA, wire=True)
+    rb2 = feat.featurize_parsed_block(small, row_bucket=8, ragged=True)
+    assert pack_batch(rb2).layout[2][2] == "u16delta"
+
+
+def test_tiny_blocks_carry_across_chunks(feat, tmp_path):
+    """block_bytes far below a line forces the consumed/carry logic through
+    the wire parser (prescreen + early-stop included)."""
+    objs = [_rt(f"carry line {i} with some length to it") for i in range(20)]
+    objs.insert(7, _rt("wide row é to flip dtype mid-stream"))
+    path = _write(tmp_path, objs, ensure_ascii=False)
+    whole = _merged(path, wire=True)
+    tiny = _merged(path, wire=True, block_bytes=64)
+    np.testing.assert_array_equal(whole.numeric, tiny.numeric)
+    np.testing.assert_array_equal(whole.offsets, tiny.offsets)
+    np.testing.assert_array_equal(
+        whole.units.astype(np.uint16), tiny.units.astype(np.uint16)
+    )
+    _assert_block_parity(_merged(path, wire=False), whole)
+
+
+def test_mixed_dtype_blocks_merge_to_uint16(tmp_path):
+    """A narrow block and a widened block from one stream merge to uint16
+    with values preserved (numpy promotion) — batch boundaries can cut a
+    stream anywhere."""
+    ascii_path = _write(tmp_path, [_rt("plain")], name="a.jsonl")
+    uni_path = _write(
+        tmp_path, [_rt("wide é")], name="u.jsonl", ensure_ascii=False
+    )
+    a = _merged(ascii_path, wire=True)
+    u = _merged(uni_path, wire=True)
+    assert a.units.dtype == np.uint8 and u.units.dtype == np.uint16
+    merged = merge_blocks([a, u])
+    assert merged.units.dtype == np.uint16
+    assert merged.rows == 2 and merged.ascii.tolist() == [1, 0]
+
+
+def test_garbage_lines_counted_and_skipped(tmp_path):
+    """Bad-line contract on the wire path: torn/garbled lines never crash
+    and stay visible (counted) while kept rows match the legacy parser."""
+    from twtml_tpu.telemetry import metrics as _metrics
+
+    good = json.dumps(_rt("survivor"))
+    lines = [good, "totally not json", "[1, 2]", good, '{"broken": ',
+             good + "   "]
+    path = tmp_path / "garbage.jsonl"
+    path.write_text("\n".join(lines) + "\n", encoding="utf-8")
+    _metrics.reset_for_tests()
+    wire = _merged(path, wire=True)
+    legacy = _merged(path, wire=False)
+    assert wire.rows == legacy.rows == 3
+    _assert_block_parity(legacy, wire)
+    assert _metrics.get_registry().counter(
+        "ingest.rows_dropped_parse"
+    ).snapshot() > 0
+
+
+def test_invalid_utf8_in_rt_text_drops_line(tmp_path):
+    """Overlong encodings drop the line; UTF-8-encoded surrogates keep it
+    (json.loads' surrogatepass view) — as in the legacy parser."""
+    good = json.dumps(_rt("ok")).encode()
+    overlong = (b'{"text": "RT", "retweeted_status": {"text": "x\xc0\xafy", '
+                b'"retweet_count": 500, "user": {"followers_count": 1}}}')
+    surrogate = (b'{"text": "RT", "retweeted_status": {"text": "x\xed\xa0\x80y", '
+                 b'"retweet_count": 500, "user": {"followers_count": 1}}}')
+    path = tmp_path / "badutf8.jsonl"
+    path.write_bytes(good + b"\n" + overlong + b"\n" + surrogate + b"\n")
+    wire = _merged(path, wire=True)
+    legacy = _merged(path, wire=False)
+    assert wire.rows == legacy.rows == 2
+    _assert_block_parity(legacy, wire)
+    assert (wire.units.astype(np.uint16) == 0xD800).sum() == 1
+
+
+@pytest.mark.parametrize("ensure_ascii", [True, False])
+def test_fuzzed_unicode_parity_wire_vs_legacy(tmp_path, ensure_ascii):
+    """Seeded fuzz (shuffled keys, nested junk, BMP/astral/controls) must
+    parse identically through the wire emitter and the legacy parser."""
+    import random
+
+    rng = random.Random(20260804 + int(ensure_ascii))
+    alphabet = (
+        [chr(c) for c in range(0x20, 0x7F)]
+        + ["\n", "\t", "\r", "\b", "\f"]
+        + [chr(rng.randrange(0xA0, 0x2FFF)) for _ in range(40)]
+        + ["é", "你", "İ", "ẞ", "\U0001f600", "\U0001f525"]
+    )
+
+    def shuffled(d):
+        items = list(d.items())
+        rng.shuffle(items)
+        return {k: shuffled(v) if isinstance(v, dict) else v for k, v in items}
+
+    objs = []
+    for i in range(200):
+        text = "".join(
+            rng.choice(alphabet) for _ in range(rng.randrange(0, 60))
+        )
+        objs.append(shuffled({
+            "text": "RT wrap",
+            "junk": {"nested": [i, None, True, {"deep": [text]}]},
+            f"unknown_{rng.randrange(10)}": rng.choice([None, True, 1.5, "s"]),
+            "retweeted_status": {
+                "text": text,
+                "retweet_count": rng.randrange(0, 2000),
+                "extra": {"a": [rng.randrange(9)]},
+                "user": {
+                    "followers_count": rng.randrange(0, 10**9),
+                    "favourites_count": rng.randrange(0, 10**6),
+                    "friends_count": rng.randrange(0, 10**5),
+                    "screen_name": "user_" + str(i),
+                },
+                "timestamp_ms": str(rng.randrange(10**12, 2 * 10**12)),
+            },
+        }))
+    path = _write(tmp_path, objs, ensure_ascii=ensure_ascii)
+    legacy = _merged(path, wire=False)
+    wire = _merged(path, wire=True)
+    assert legacy.rows > 20
+    _assert_block_parity(legacy, wire)
+
+
+def test_duplicate_keys_match_legacy(tmp_path):
+    """Duplicate text/retweeted_status occurrences: the wire parser keeps
+    the legacy any-occurrence capping and last-content-wins rules."""
+    dup_text = (
+        '{"text": "RT", "retweeted_status": {"text": "first", '
+        '"text": "last wins", "retweet_count": 500, '
+        '"user": {"followers_count": 7}}}'
+    )
+    dup_rt = (
+        '{"text": "RT", "retweeted_status": {"text": "one", '
+        '"retweet_count": 500}, "retweeted_status": {"text": "two", '
+        '"retweet_count": 600, "user": {"followers_count": 9}}}'
+    )
+    oversized_first = (
+        '{"text": "RT", "retweeted_status": {"text": "'
+        + "d" * 4097
+        + '", "text": "small", "retweet_count": 500, '
+        '"user": {"followers_count": 1}}}'
+    )
+    path = tmp_path / "dups.jsonl"
+    path.write_text(
+        "\n".join([dup_text, dup_rt, oversized_first]) + "\n",
+        encoding="utf-8",
+    )
+    legacy = _merged(path, wire=False)
+    wire = _merged(path, wire=True)
+    _assert_block_parity(legacy, wire)
+    assert wire.rows == 2  # the oversized-duplicate line dropped
+
+
+def test_unit_labels_accept_narrow_blocks(tmp_path):
+    """sentiment_labels_from_units (unit_label_fn) must score uint8 narrow
+    blocks identically to the uint16 legacy blocks."""
+    from twtml_tpu.features.sentiment import sentiment_labels_from_units
+
+    path = _write(
+        tmp_path,
+        [_rt("good happy day"), _rt("bad sad loss"), _rt("neutral words")],
+    )
+    legacy = _merged(path, wire=False)
+    wire = _merged(path, wire=True)
+    assert wire.units.dtype == np.uint8
+    np.testing.assert_array_equal(
+        sentiment_labels_from_units(wire.units, wire.offsets),
+        sentiment_labels_from_units(legacy.units, legacy.offsets),
+    )
+
+
+def test_padded_wire_from_narrow_block(feat, tmp_path):
+    """ragged=False on a uint8 block: the pad path widens once and matches
+    the object path (the emitter targets the ragged wire, but a padded
+    consumer must not read garbage)."""
+    path = _write(tmp_path, [_rt(f"padded path {i}") for i in range(4)])
+    wire = _merged(path, wire=True)
+    assert wire.units.dtype == np.uint8
+    blk = feat.featurize_parsed_block(wire, row_bucket=8, unit_bucket=32)
+    with open(path, encoding="utf-8") as fh:
+        statuses = [Status.from_json(json.loads(l)) for l in fh if l.strip()]
+    obj = feat.featurize_batch_units(statuses, row_bucket=8, unit_bucket=32)
+    for f in ("units", "length", "numeric", "label", "mask"):
+        np.testing.assert_array_equal(getattr(obj, f), getattr(blk, f))
+
+
+def test_normalize_accents_on_narrow_block(tmp_path):
+    """normalize_accents marks every row redo: a uint8 block must widen for
+    the Unicode round-trip instead of mis-decoding."""
+    feat = Featurizer(now_ms=1785320000000, normalize_accents=True)
+    path = _write(tmp_path, [_rt("cafe latte plain")])
+    wire = _merged(path, wire=True)
+    assert wire.units.dtype == np.uint8
+    batch = feat.featurize_parsed_block(
+        wire, row_bucket=8, unit_bucket=32, ragged=True
+    )
+    with open(path, encoding="utf-8") as fh:
+        statuses = [Status.from_json(json.loads(l)) for l in fh if l.strip()]
+    obj = feat.featurize_batch_ragged(statuses, row_bucket=8, unit_bucket=32)
+    # values must match unit for unit; the block path conservatively keeps
+    # the WIDE wire under normalize_accents (redo marks every row — the
+    # pre-existing rule, featurize_parsed_block) while the object path can
+    # re-check isascii post-strip, so dtypes may differ in this uncommon
+    # config (wire representation only; the device hash upcasts either way)
+    for f in ("units", "offsets", "numeric", "label", "mask"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(obj, f), dtype=np.float64),
+            np.asarray(getattr(batch, f), dtype=np.float64),
+        )
+    assert obj.row_len == batch.row_len
+
+
+# ---------------------------------------------------------------------------
+# the stale-library degrade seam (features/native.py)
+
+
+def test_wire_missing_degrades_to_legacy_parser(tmp_path, monkeypatch):
+    """A library without the wire symbol: parse_tweet_block_wire returns
+    None, the block source falls back to the legacy parser, and the batches
+    keep flowing — no AttributeError mid-stream."""
+    monkeypatch.setattr(native, "_wire_missing", True)
+    assert native.parse_tweet_block_wire(b'{"a":1}\n', 100, 1000) is None
+    assert not native.wire_available()
+    wire_requested = _merged(DATA, wire=True)  # silently legacy-parsed
+    legacy = _merged(DATA, wire=False)
+    assert wire_requested.units.dtype == np.uint16
+    _assert_block_parity(legacy, wire_requested)
+
+
+def test_bind_wire_flags_missing_symbol_and_counts(monkeypatch):
+    """_bind_wire on a symbol-less library object: non-strict flags the
+    degrade (warning + native.wire_degraded counter), strict raises so
+    get_lib's rebuild path can kick in."""
+    from twtml_tpu.telemetry import metrics as _metrics
+
+    class _NoWire:
+        def __getattr__(self, name):
+            raise AttributeError(name)
+
+    _metrics.reset_for_tests()
+    monkeypatch.setattr(native, "_wire_missing", False)
+    with pytest.raises(AttributeError):
+        native._bind_wire(_NoWire(), strict=True)
+    native._bind_wire(_NoWire(), strict=False)
+    assert native._wire_missing
+    assert _metrics.get_registry().counter(
+        "native.wire_degraded"
+    ).snapshot() == 1
+    # restore the real binding for the rest of the session
+    monkeypatch.setattr(native, "_wire_missing", False)
+
+
+def test_stale_library_without_wire_symbol_loads_degraded(tmp_path):
+    """End-to-end seam: an actual .so missing parse_tweet_block_wire loads
+    with strict=False, flags the degrade, and keeps the OLD symbols
+    callable (the ParsedBlock path stays native, not Python)."""
+    import subprocess
+
+    src = tmp_path / "stale.cpp"
+    # a minimal stale lib: every pre-wire symbol present (as stubs), no
+    # parse_tweet_block_wire
+    src.write_text(
+        """
+#include <cstdint>
+extern "C" {
+int32_t fasthash_batch(uint16_t*, int64_t*, int32_t, int32_t, int32_t,
+                       int32_t*, float*, int32_t*, int32_t) { return 0; }
+int32_t pad_units_batch(uint16_t*, int64_t*, int32_t, int32_t, int32_t,
+                        int32_t, uint16_t*, int32_t*) { return 0; }
+int32_t pad_units_batch_u8(uint16_t*, int64_t*, int32_t, int32_t, int32_t,
+                           int32_t, uint8_t*, int32_t*) { return 0; }
+void lexicon_score_batch(uint16_t*, int64_t*, int32_t, uint16_t*, int64_t*,
+                         int32_t*, int32_t, uint16_t*, int64_t*, int32_t*,
+                         int32_t, int32_t*, uint8_t*) {}
+int64_t parse_tweet_block(const char*, int64_t, int64_t, int64_t, int64_t,
+                          int64_t, int64_t*, uint16_t*, int64_t*, uint8_t*,
+                          int64_t* c, int64_t* b) { *c = 0; *b = 0; return 0; }
+}
+""",
+        encoding="utf-8",
+    )
+    so = tmp_path / "stale.so"
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-o", str(so), str(src)],
+        check=True, capture_output=True,
+    )
+    saved = native._wire_missing
+    try:
+        with pytest.raises(AttributeError):
+            native._load(str(so), strict=True)
+        lib = native._load(str(so), strict=False)
+        assert native._wire_missing
+        assert lib.parse_tweet_block is not None  # old symbols still bound
+    finally:
+        native._wire_missing = saved
+        # rebind the real library's wire entry (module-global flag shared)
+        real = native.get_lib()
+        if real is not None:
+            native._bind_wire(real, strict=False)
+
+
+# ---------------------------------------------------------------------------
+# metrics + app-level parity
+
+
+def test_parse_metrics_published(tmp_path):
+    from twtml_tpu.telemetry import metrics as _metrics
+
+    _metrics.reset_for_tests()
+    _merged(DATA, wire=True)
+    reg = _metrics.get_registry()
+    assert reg.counter("ingest.parse_bytes").snapshot() >= os.path.getsize(
+        DATA
+    )
+    assert reg.gauge("ingest.parse_tweets_per_s").snapshot() > 0
+
+
+def test_linear_app_wire_matches_legacy_block(tmp_path, capsys):
+    """End to end through the CLI run() in the back-to-back ragged regime
+    (where --blockWire auto engages): --blockWire on == --blockWire off ==
+    --ingest object, stat line for stat line."""
+    from twtml_tpu.apps import linear_regression as app
+    from twtml_tpu.config import ConfArguments
+
+    outputs = {}
+    for name, args in (
+        ("object", ["--ingest", "object"]),
+        ("block-legacy", ["--ingest", "block", "--blockWire", "off"]),
+        ("block-wire", ["--ingest", "block", "--blockWire", "on"]),
+    ):
+        conf = ConfArguments().parse([
+            "--source", "replay", "--replayFile", DATA, "--seconds", "0",
+            "--batchBucket", "16", "--tokenBucket", "128",
+            "--lightning", "http://127.0.0.1:9",
+            "--twtweb", "http://127.0.0.1:9", "--webTimeout", "0.2",
+            "--backend", "cpu", "--master", "local[1]", *args,
+        ])
+        assert conf.effective_wire() == "ragged"
+        app.run(conf, max_batches=1)
+        outputs[name] = [
+            l for l in capsys.readouterr().out.splitlines()
+            if l.startswith("count:")
+        ]
+    assert outputs["block-wire"] == outputs["block-legacy"] == outputs["object"]
+    assert outputs["block-wire"], "no stats lines captured"

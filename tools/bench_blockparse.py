@@ -1,0 +1,191 @@
+"""Zero-copy block parse bench: wire emitter vs legacy ParsedBlock chain.
+
+ISSUE 6 / ROADMAP item 4: the post-wire bottleneck ladder names host parse
+(~1.2M tweets/s recorded, r5) as the binding stage of block ingest. This
+tool measures the zero-copy wire emitter (``native.parse_tweet_block_wire``
+through ``BlockReplayFileSource(wire=True)``) against the legacy parser on
+the SAME corpus, with the house method (tools/pairedbench.py): single
+passes round-robin all arms inside one budget window, paired per-round
+ratios — phase-robust, the only way wire/dispatch verdicts are quoted here.
+
+Two stage pairs, four arms interleaved per round:
+
+  parse:legacy / parse:wire — file bytes → blocks (``produce()`` drained,
+      exactly the suite's parse-stage measurement, copy=False views);
+  chain:legacy / chain:wire — file bytes → PACKED ragged wire batches
+      (produce → iter_row_chunks → featurize_parsed_block(ragged, pack)):
+      the full host side of block ingest, no device.
+
+Parity is asserted before timing: blocks unit-for-unit, packed buffers
+byte-for-byte. Host-only — no jax, runs on any box.
+
+Usage: python tools/bench_blockparse.py [--tweets N] [--batch B]
+       [--budget S] [--blockBytes N] [--corpus ascii|unicode]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    n_tweets, batch_size, budget = 65536, 1024, 30.0
+    block_bytes, corpus = 4 << 20, "ascii"
+    i = 0
+    while i < len(args):
+        if args[i] == "--tweets":
+            n_tweets = int(args[i + 1]); i += 2
+        elif args[i] == "--batch":
+            batch_size = int(args[i + 1]); i += 2
+        elif args[i] == "--budget":
+            budget = float(args[i + 1]); i += 2
+        elif args[i] == "--blockBytes":
+            block_bytes = int(args[i + 1]); i += 2
+        elif args[i] == "--corpus":
+            corpus = args[i + 1]; i += 2
+        else:
+            raise SystemExit(f"unknown flag {args[i]!r}")
+    if corpus not in ("ascii", "unicode"):
+        raise SystemExit("--corpus must be ascii or unicode")
+
+    import numpy as np
+
+    from tools.bench_suite import _status_json
+    from tools.pairedbench import (
+        best_median_rate,
+        paired_ratio_median,
+        paired_ratios,
+        run_rounds,
+    )
+    from twtml_tpu.features import native
+    from twtml_tpu.features.blocks import iter_row_chunks, merge_blocks
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.streaming.sources import BlockReplayFileSource
+
+    if not native.wire_available():
+        print(json.dumps({"skipped": "native wire emitter unavailable"}))
+        return
+
+    # ---- corpus: the suite's synthetic stream, materialized once ---------
+    from twtml_tpu.streaming.sources import SyntheticSource
+
+    statuses = list(SyntheticSource(total=n_tweets, seed=3).produce())
+    if corpus == "unicode":
+        # ~6% non-ASCII rows: exercises the widen path honestly (the wire
+        # parser must carry uint16 end to end once any row widens)
+        marks = ("é", "火", "\U0001f600")
+        for k, s in enumerate(statuses):
+            if k % 16 == 7:
+                s.retweeted_status.text += " " + marks[k % 3]
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".jsonl", delete=False
+    ) as fh:
+        for s in statuses:
+            fh.write(json.dumps(_status_json(s), ensure_ascii=False) + "\n")
+        path = fh.name
+
+    feat = Featurizer(now_ms=1785320000000)
+
+    def source(wire: bool) -> BlockReplayFileSource:
+        return BlockReplayFileSource(
+            path, copy=False, block_bytes=block_bytes, wire=wire
+        )
+
+    def featurize(sub):
+        return feat.featurize_parsed_block(
+            sub, row_bucket=batch_size, ragged=True, pack=True
+        )
+
+    try:
+        # ---- parity gate (never time an unverified fast path) ------------
+        legacy = merge_blocks(list(source(False).produce()))
+        wire = merge_blocks(list(source(True).produce()))
+        rows = legacy.rows
+        np.testing.assert_array_equal(legacy.numeric, wire.numeric)
+        np.testing.assert_array_equal(legacy.offsets, wire.offsets)
+        np.testing.assert_array_equal(legacy.ascii, wire.ascii)
+        np.testing.assert_array_equal(
+            legacy.units.astype(np.uint16), wire.units.astype(np.uint16)
+        )
+        for a, b in zip(
+            iter_row_chunks([legacy], batch_size),
+            iter_row_chunks([wire], batch_size),
+        ):
+            pa, pb = featurize(a), featurize(b)
+            assert pa.layout == pb.layout
+            np.testing.assert_array_equal(pa.buffer, pb.buffer)
+
+        # ---- arms (each returns one pass's wall seconds) -----------------
+        def parse_pass(wire_on):
+            def run():
+                t0 = time.perf_counter()
+                n = 0
+                for b in source(wire_on).produce():
+                    n += b.rows
+                dt = time.perf_counter() - t0
+                assert n == rows
+                return dt
+            return run
+
+        def chain_pass(wire_on):
+            def run():
+                t0 = time.perf_counter()
+                n = 0
+                for sub in iter_row_chunks(
+                    source(wire_on).produce(), batch_size
+                ):
+                    featurize(sub)
+                    n += sub.rows
+                dt = time.perf_counter() - t0
+                assert n == rows
+                return dt
+            return run
+
+        arms = {
+            "parse_legacy": parse_pass(False),
+            "parse_wire": parse_pass(True),
+            "chain_legacy": chain_pass(False),
+            "chain_wire": chain_pass(True),
+        }
+        for run in arms.values():  # warmup (page cache, allocator, numpy)
+            run()
+        times = run_rounds(arms, budget, min_rounds=3)
+
+        out = {
+            "corpus": corpus,
+            "tweets": rows,
+            "batch": batch_size,
+            "block_bytes": block_bytes,
+            "wire_units_dtype": str(wire.units.dtype),
+        }
+        for name, ts in times.items():
+            best, median = best_median_rate(ts, rows)
+            out[name] = {
+                "tweets_per_sec": best,
+                "median_tweets_per_sec": median,
+                "passes": len(ts),
+            }
+        for stage in ("parse", "chain"):
+            base, arm = times[f"{stage}_legacy"], times[f"{stage}_wire"]
+            out[f"{stage}_paired_speedup_median"] = paired_ratio_median(
+                base, arm
+            )
+            out[f"{stage}_paired_speedup_all"] = [
+                round(x, 3) for x in paired_ratios(base, arm)
+            ]
+        print(json.dumps(out))
+    finally:
+        os.unlink(path)
+
+
+if __name__ == "__main__":
+    main()

@@ -333,9 +333,12 @@ def run_config(name: str, n_tweets: int, batch_size: int = 0) -> dict:
             def pipeline_source():
                 # copy=False: blocks are views, featurized promptly; 4MB
                 # blocks amortize per-call overhead (measured best on this
-                # host with the view path)
+                # host with the view path). wire=True: the r9 zero-copy
+                # emitter — the shipped config-#1 path (--blockWire auto
+                # resolves on for the ragged wire; paired 1.6× on the parse
+                # stage, BENCHMARKS.md "Zero-copy block parse")
                 return BlockReplayFileSource(
-                    path, copy=False, block_bytes=4 << 20
+                    path, copy=False, block_bytes=4 << 20, wire=True
                 ).produce()
 
             def one_pass():

@@ -231,6 +231,9 @@ def build_source(
             # cluster runs too (r5; was a SystemExit)
             source: Source = BlockReplayFileSource(
                 conf.replayFile, num_retweet_begin=begin, num_retweet_end=end,
+                # zero-copy wire emitter (--blockWire): raw bytes → ragged
+                # wire units in one C pass, byte-identical batches
+                wire=conf.effective_block_wire(),
                 shard_index=jax.process_index() if multihost else 0,
                 shard_count=jax.process_count() if multihost else 1,
             )
@@ -252,7 +255,8 @@ def build_source(
                 else (conf.numRetweetBegin, conf.numRetweetEnd)
             )
             source = BlockTwitterSource.from_properties(
-                num_retweet_begin=begin, num_retweet_end=end
+                num_retweet_begin=begin, num_retweet_end=end,
+                wire=conf.effective_block_wire(),
             )
             return _wrap_faults(source, conf)
         source = TwitterSource.from_properties()

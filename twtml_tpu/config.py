@@ -131,6 +131,12 @@ class ConfArguments:
             raise ValueError(
                 f"wire must be 'auto', 'padded' or 'ragged', got {self.wire!r}"
             )
+        self.blockWire: str = conf.get("blockWire", "auto")
+        if self.blockWire not in ("auto", "on", "off"):
+            raise ValueError(
+                f"blockWire must be 'auto', 'on' or 'off', got "
+                f"{self.blockWire!r}"
+            )
         self.l2Reg: float = float(conf.get("l2Reg", "0.0"))
         self.convergenceTol: float = float(conf.get("convergenceTol", "0.001"))
         self.dtype: str = conf.get("dtype", "float32")
@@ -327,6 +333,14 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                                                Default: {self.sentinelRollbacks}
   --sentinelWindow <int batches>               The rollback-rate window above.
                                                Default: {self.sentinelWindow}
+  --blockWire <auto|on|off>                    Zero-copy native ingest for --ingest block:
+                                               'on' parses raw block bytes straight into the
+                                               ragged wire's unit representation (one C pass,
+                                               uint8 units when every row is ASCII — no
+                                               intermediate repack); byte-identical batches
+                                               (tests/test_blockwire.py). auto = on whenever
+                                               the effective wire is ragged; off = the legacy
+                                               ParsedBlock parser. Default: {self.blockWire}
   --wirePack <auto|stacked|group>              Superbatch wire layout on the ragged wire:
                                                'group' coalesces the K batches into ONE
                                                contiguous buffer (one put; uint16-delta offsets)
@@ -409,6 +423,10 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
             self.wire = take()
             if self.wire not in ("auto", "padded", "ragged"):
                 self.printUsage(1)
+        elif flag == "--blockWire":
+            self.blockWire = take()
+            if self.blockWire not in ("auto", "on", "off"):
+                self.printUsage(1)
         elif flag == "--l2Reg":
             self.l2Reg = float(take())
         elif flag == "--convergenceTol":
@@ -487,6 +505,22 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
         if self.hashOn != "device" or self.seconds > 0:
             return "padded"
         return "ragged"
+
+    def effective_block_wire(self) -> bool:
+        """Resolve ``--blockWire``: whether block sources should parse
+        through the zero-copy wire emitter (raw bytes → ragged-wire units
+        in one C pass, features/native.parse_tweet_block_wire). ``auto``
+        (the default) follows the effective wire: the emitter produces the
+        RAGGED wire's unit representation (narrow uint8 units), so it is
+        on exactly when the stream ships ragged; the padded wire keeps the
+        legacy ParsedBlock parser (its C pad copy reads uint16). The
+        batches are byte-identical either way — this flag moves work, not
+        semantics (tests/test_blockwire.py) — and a library without the
+        emitter degrades to the legacy parser on its own
+        (features/native.py seam)."""
+        if self.blockWire != "auto":
+            return self.blockWire == "on"
+        return self.effective_wire() == "ragged"
 
     def effective_wire_pack(self) -> str:
         """Resolve ``--wirePack auto`` to the measured-default superbatch

@@ -31,7 +31,14 @@ class ParsedBlock(NamedTuple):
     """Filtered, columnar tweets. ``numeric`` is int64 [rows, 5] (see COL_*),
     ``units`` the concatenated UTF-16 code units of the original texts (NOT
     lowercased), ``offsets`` int64 [rows+1] into units, ``ascii`` uint8
-    [rows] (1 = every unit < 128, so ASCII pad-time folding suffices)."""
+    [rows] (1 = every unit < 128, so ASCII pad-time folding suffices).
+
+    ``units`` is uint16, or **uint8** straight from the zero-copy wire
+    parser (``native.parse_tweet_block_wire``) when every row is ASCII —
+    the ragged wire's narrow dtype, carried from the parser so no
+    downstream downcast pass exists. The values are the same code units
+    either way; ``merge_blocks`` of mixed-dtype blocks promotes to uint16
+    (numpy concatenate), which is exactly the non-ASCII wire dtype."""
 
     numeric: np.ndarray
     units: np.ndarray

@@ -90,6 +90,12 @@ def _pad_ragged_units(
     (apps/common.warmup_compile warms both)."""
     from . import native
 
+    if units.dtype == np.uint8:
+        # narrow-wire block units (zero-copy parser) on the PADDED wire:
+        # the C pad copy reads uint16 — widen once (the padded wire is not
+        # the wire parser's target; apps gate it to the ragged wire)
+        units = units.astype(np.uint16)
+
     padded = (
         native.pad_units((units, offsets), n, b, lu, ascii_lower=True,
                          narrow=narrow)
@@ -207,7 +213,10 @@ class Featurizer:
     # for hot paths — e.g. features/sentiment.py sentiment_labels
     batch_label_fn: "Callable[[list[Status]], np.ndarray] | None" = None
     # optional labeler over ragged UTF-16 units for the block-ingest path,
-    # where no Status objects exist — e.g. sentiment_labels_from_units
+    # where no Status objects exist — e.g. sentiment_labels_from_units.
+    # NOTE: narrow-wire blocks (zero-copy parser) carry uint8 units —
+    # labelers must accept either dtype (values are code units either way;
+    # sentiment_labels_from_units upcasts internally)
     unit_label_fn: "Callable[[np.ndarray, np.ndarray], np.ndarray] | None" = None
     num_number_features: int = field(default=NUM_NUMBER_FEATURES, init=False)
 
@@ -579,6 +588,12 @@ class Featurizer:
             if self.normalize_accents
             else np.nonzero(block.ascii == 0)[0]
         )
+        if n and redo.size and units.dtype == np.uint8:
+            # narrow-wire block (the zero-copy parser emits uint8 units
+            # when every row is ASCII, so redo is normally empty here) that
+            # still needs the per-row Unicode round-trip — only under
+            # normalize_accents: widen once for the utf-16 decode below
+            units = units.astype(np.uint16)
         if n and redo.size:
             # per-row Unicode round-trip for the rows that need it. The
             # common case (lower() preserves length) writes in place —

@@ -46,18 +46,33 @@ def _sources_newer_than_lib() -> bool:
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _tried = False
+# set when the loaded library predates the wire emitter (stale .so whose
+# OLD symbol set still works): parse_tweet_block_wire() then returns None
+# and block sources degrade LOUDLY to the ParsedBlock path — one warning +
+# a registry counter, never a ctypes AttributeError mid-stream
+_wire_missing = False
 
 
 def _build() -> bool:
+    # build to a temp path and os.replace: dlopen caches by inode, so a
+    # rebuild in place would hand a retrying loader the same stale image —
+    # the replace gives the retry a fresh inode (and never destroys a
+    # still-loadable old library when the compile itself fails)
+    tmp = _LIB + ".tmp"
     try:
         subprocess.run(
             ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-pthread",
-             "-o", _LIB, *_SRCS],
+             "-o", tmp, *_SRCS],
             check=True, capture_output=True, timeout=120,
         )
+        os.replace(tmp, _LIB)
         return True
     except Exception as exc:
         log.warning("native featurizer build failed (%s); using python path", exc)
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
         return False
 
 
@@ -75,21 +90,21 @@ def get_lib() -> ctypes.CDLL | None:
             lib = _load(_LIB)
         except AttributeError:
             # stale .so from before a symbol was added (mtime-equal artifact
-            # copy defeats the rebuild check): rebuild once and retry.
-            # Unlink first — dlopen caches by inode, so rebuilding in place
-            # would hand the retry the same stale image; a fresh inode loads.
-            try:
-                os.remove(_LIB)
-            except OSError:
-                pass
-            if not _sources_ok() or not _build():
-                log.warning("native featurizer is stale and could not be "
-                            "rebuilt; using python path")
-                return None
-            try:
-                lib = _load(_LIB)
-            except (OSError, AttributeError) as exc:
-                log.warning("native featurizer load failed (%s)", exc)
+            # copy defeats the rebuild check): rebuild once (to a fresh
+            # inode — see _build) and retry
+            if _sources_ok() and _build():
+                try:
+                    lib = _load(_LIB)
+                except AttributeError:
+                    lib = _try_degraded_load()
+                except OSError as exc:
+                    log.warning("native featurizer load failed (%s)", exc)
+                    return None
+            else:
+                # cannot rebuild: keep the stale library usable for the
+                # symbols it HAS — only the wire entry degrades (loudly)
+                lib = _try_degraded_load()
+            if lib is None:
                 return None
         except OSError as exc:
             log.warning("native featurizer load failed (%s)", exc)
@@ -98,8 +113,23 @@ def get_lib() -> ctypes.CDLL | None:
         return _lib
 
 
-def _load(path: str) -> ctypes.CDLL:
-    """dlopen + bind every exported symbol; AttributeError = stale library."""
+def _try_degraded_load() -> ctypes.CDLL | None:
+    """Last-resort load of a stale library: every pre-wire symbol must
+    bind (those AttributeErrors stay fatal — the lib is unusably old), but
+    a missing wire emitter only flags ``_wire_missing`` so block sources
+    fall back to the ParsedBlock path instead of dying mid-stream."""
+    try:
+        return _load(_LIB, strict=False)
+    except (OSError, AttributeError) as exc:
+        log.warning("native featurizer is stale and could not be rebuilt "
+                    "or loaded (%s); using python path", exc)
+        return None
+
+
+def _load(path: str, strict: bool = True) -> ctypes.CDLL:
+    """dlopen + bind every exported symbol; AttributeError = stale library.
+    ``strict=False`` tolerates exactly one absence — the wire emitter —
+    by flagging ``_wire_missing`` instead of raising (see get_lib)."""
     lib = ctypes.CDLL(path)
     lib.fasthash_batch.restype = ctypes.c_int32
     lib.fasthash_batch.argtypes = [
@@ -166,7 +196,51 @@ def _load(path: str) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int64),  # consumed
         ctypes.POINTER(ctypes.c_int64),  # bad_lines
     ]
+    _bind_wire(lib, strict)
     return lib
+
+
+def _bind_wire(lib: ctypes.CDLL, strict: bool) -> None:
+    """Bind the zero-copy wire emitter. A library missing it is stale;
+    strict loads raise (so get_lib's rebuild kicks in), degraded loads flag
+    ``_wire_missing`` ONCE — warning + ``native.wire_degraded`` counter —
+    and the block sources keep running on the ParsedBlock path."""
+    global _wire_missing
+    try:
+        fn = lib.parse_tweet_block_wire
+    except AttributeError:
+        if strict:
+            raise
+        _wire_missing = True
+        log.warning(
+            "native library is stale: parse_tweet_block_wire missing — "
+            "block sources degrade to the ParsedBlock parser (delete "
+            "native/libfasthash.so to force a rebuild of the zero-copy "
+            "wire path)"
+        )
+        from ..telemetry import metrics as _metrics
+
+        _metrics.get_registry().counter("native.wire_degraded").inc()
+        return
+    fn.restype = ctypes.c_int64
+    fn.argtypes = [
+        ctypes.c_char_p,  # buf
+        ctypes.c_int64,  # len
+        ctypes.c_int64,  # begin
+        ctypes.c_int64,  # end
+        ctypes.c_int64,  # cap_rows
+        ctypes.c_int64,  # cap_units
+        ctypes.POINTER(ctypes.c_int64),  # out_numeric [rows,5]
+        ctypes.POINTER(ctypes.c_uint8),  # out_units_u8
+        ctypes.POINTER(ctypes.c_uint16),  # out_units_u16
+        ctypes.POINTER(ctypes.c_int64),  # out_offsets [rows+1]
+        ctypes.POINTER(ctypes.c_uint8),  # out_ascii [rows]
+        ctypes.POINTER(ctypes.c_int64),  # consumed
+        ctypes.POINTER(ctypes.c_int64),  # bad_lines
+        ctypes.POINTER(ctypes.c_int64),  # narrow (out)
+        ctypes.POINTER(ctypes.c_int64),  # needs_wide (out)
+    ]
+    _wire_missing = False
 
 
 def available() -> bool:
@@ -360,6 +434,90 @@ def parse_tweet_block(
     return (
         numeric[:rows],
         units[: offsets[rows]],
+        offsets[: rows + 1],
+        ascii_flags[:rows],
+        int(consumed.value),
+        int(bad.value),
+    )
+
+
+def wire_available() -> bool:
+    """Whether the zero-copy wire emitter is loadable (the library is up
+    and carries the symbol — see _bind_wire's degrade seam)."""
+    return get_lib() is not None and not _wire_missing
+
+
+def parse_tweet_block_wire(
+    data: bytes,
+    begin: int,
+    end: int,
+    cap_rows: int = 0,
+    copy: bool = True,
+) -> tuple | None:
+    """One C pass from raw block bytes to the ragged wire's unit
+    representation (native/tweetjson.cpp parse_tweet_block_wire): same
+    kept rows / numeric / offsets / ascii as ``parse_tweet_block``, but the
+    units come back **uint8** whenever every kept row is ASCII (the narrow
+    wire — no separate downcast pass) and uint16 otherwise (the parser
+    widens its committed prefix ONCE, in C, when the first non-ASCII row
+    commits). Returns the same tuple shape as ``parse_tweet_block``
+    (numeric, units, offsets, ascii, consumed, bad) — callers can treat
+    the two interchangeably — or None when the C library is unavailable
+    OR predates the wire emitter (``_wire_missing``; callers fall back to
+    the ParsedBlock path, which keeps working on old symbol sets).
+
+    Both unit buffers are allocated with ``np.empty`` up front; the wide
+    one stays untouched (no page faults) unless a row actually widens, so
+    the common ASCII stream never pays for it."""
+    lib = get_lib()
+    if lib is None or _wire_missing:
+        return None
+    n = len(data)
+    if cap_rows <= 0:
+        cap_rows = max(16, n >> 6)  # same over-provision rule as above
+    cap_units = n + MAX_TEXT_UNITS + 1
+    numeric = np.empty((cap_rows, 5), dtype=np.int64)
+    units_u8 = np.empty((cap_units,), dtype=np.uint8)
+    units_u16 = np.empty((cap_units,), dtype=np.uint16)
+    offsets = np.empty((cap_rows + 1,), dtype=np.int64)
+    ascii_flags = np.empty((cap_rows,), dtype=np.uint8)
+    consumed = ctypes.c_int64(0)
+    bad = ctypes.c_int64(0)
+    narrow = ctypes.c_int64(0)
+    needs_wide = ctypes.c_int64(0)
+    rows = lib.parse_tweet_block_wire(
+        data,
+        n,
+        begin,
+        end,
+        cap_rows,
+        cap_units,
+        numeric.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        units_u8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        units_u16.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ascii_flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.byref(consumed),
+        ctypes.byref(bad),
+        ctypes.byref(narrow),
+        ctypes.byref(needs_wide),
+    )
+    if needs_wide.value:  # can't happen: a wide buffer is always passed
+        raise RuntimeError("wire parser requested a wide buffer it was given")
+    units = units_u8 if narrow.value else units_u16
+    total = int(offsets[rows]) if rows else 0
+    if copy:
+        return (
+            numeric[:rows].copy(),
+            units[:total].copy(),
+            offsets[: rows + 1].copy(),
+            ascii_flags[:rows].copy(),
+            int(consumed.value),
+            int(bad.value),
+        )
+    return (
+        numeric[:rows],
+        units[:total],
         offsets[: rows + 1],
         ascii_flags[:rows],
         int(consumed.value),

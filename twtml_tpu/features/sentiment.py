@@ -105,6 +105,10 @@ def sentiment_labels_from_units(units, offsets) -> "np.ndarray":
     n = offsets.size - 1
     if n <= 0:
         return np.zeros((0,), np.float32)
+    if units.dtype == np.uint8:
+        # narrow-wire block (zero-copy parser): the C lexicon scan reads
+        # uint16 units — widen once; values are identical code units
+        units = units.astype(np.uint16)
     out = native.lexicon_scores((units, offsets), n, _POS_PACKED, _NEG_PACKED)
     if out is None:  # no C library: every row takes the Python loop below
         score = np.zeros((n,), np.int32)

@@ -259,11 +259,24 @@ class BlockParserMixin:
     """The bytes → ParsedBlock stage both block sources share (file replay
     below and the live ``BlockTwitterSource``, twitter.py): the native C
     parser with the pure-Python ground-truth fallback. Consumers set
-    ``begin``/``end`` (the retweet-interval filter) and ``copy``."""
+    ``begin``/``end`` (the retweet-interval filter) and ``copy``.
+
+    ``wire=True`` parses through the zero-copy wire emitter
+    (``native.parse_tweet_block_wire``): one C pass from raw bytes to the
+    ragged wire's unit representation — blocks then carry **uint8** units
+    whenever every kept row is ASCII (the narrow wire dtype, decided by the
+    parser's per-row metadata, so the featurizer's downcast pass
+    disappears). Kept rows and every emitted array are byte-identical to
+    the legacy parser (tests/test_blockwire.py); only bad-line COUNTS may
+    undercount on keyless malformed lines (the prescreen skips whole-line
+    validation there — native/tweetjson.cpp banner). Degrades in order:
+    wire emitter → legacy C parser (stale library without the symbol,
+    counted + warned once by features/native.py) → Python ground truth."""
 
     begin: int
     end: int
     copy: bool = True
+    wire: bool = False
 
     def parse_buffer(self, data: bytes) -> "list":
         """Parse a whole byte buffer (must end at a line boundary) into
@@ -284,21 +297,41 @@ class BlockParserMixin:
     def _parse(self, data: bytes):
         """(ParsedBlock | None, carry bytes) for one buffered chunk —
         instrumented as the ``parse`` stage (one real span per chunk; the
-        block path parses MB-scale buffers, so per-chunk spans are cheap)."""
+        block path parses MB-scale buffers, so per-chunk spans are cheap).
+        The parse rate and byte volume are first-class registry state
+        (``ingest.parse_tweets_per_s`` gauge, ``ingest.parse_bytes``
+        counter): the bottleneck ladder's parse rung is readable off
+        /api/metrics without a bench run, and the PR 5 straggler ladder's
+        ``parse`` attribution keeps riding the same ``record_stage`` clock
+        whichever parser (wire / legacy / Python) ran."""
+        from ..telemetry import metrics as _metrics
         from ..telemetry import trace as _trace
 
         tr = _trace.get()
         t0 = time.perf_counter()
         if not tr.enabled:
             out = self._parse_impl(data)
-            _sideband.record_stage("parse", time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            _sideband.record_stage("parse", dt)
+            self._record_parse_metrics(_metrics, len(data), out[0], dt)
             return out
         with tr.span("parse", bytes=len(data)) as sp:
             block, rest = self._parse_impl(data)
             if block is not None:
                 sp.add(rows=int(block.rows))
-        _sideband.record_stage("parse", time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        _sideband.record_stage("parse", dt)
+        self._record_parse_metrics(_metrics, len(data), block, dt)
         return block, rest
+
+    @staticmethod
+    def _record_parse_metrics(_metrics, nbytes: int, block, dt: float) -> None:
+        reg = _metrics.get_registry()
+        reg.counter("ingest.parse_bytes").inc(nbytes)
+        if block is not None and dt > 0:
+            reg.gauge("ingest.parse_tweets_per_s").set(
+                round(block.rows / dt, 1)
+            )
 
     def _parse_impl(self, data: bytes):
         from ..features import native
@@ -307,7 +340,17 @@ class BlockParserMixin:
         # --chaos source.garbage: damage the buffer BEFORE the parser —
         # the skip-and-count contract below is what absorbs it
         data = _maybe_corrupt(data)
-        out = native.parse_tweet_block(data, self.begin, self.end, copy=self.copy)
+        out = (
+            native.parse_tweet_block_wire(
+                data, self.begin, self.end, copy=self.copy
+            )
+            if self.wire
+            else None
+        )
+        if out is None:
+            out = native.parse_tweet_block(
+                data, self.begin, self.end, copy=self.copy
+            )
         if out is not None:
             numeric, units, offsets, ascii_flags, consumed, bad = out
             if bad:
@@ -427,6 +470,7 @@ class BlockReplayFileSource(BlockParserMixin, Source):
         block_bytes: int = 1 << 20,
         loop: bool = False,
         copy: bool = True,
+        wire: bool = False,
         shard_index: int = 0,
         shard_count: int = 1,
         **kw,
@@ -441,6 +485,9 @@ class BlockReplayFileSource(BlockParserMixin, Source):
         # native.parse_tweet_block) — for consumers that featurize each
         # block promptly (the bench pipeline), not for accumulation
         self.copy = copy
+        # wire=True: parse through the zero-copy wire emitter (see
+        # BlockParserMixin) — apps enable it for the ragged device wire
+        self.wire = wire
         if not 0 <= shard_index < max(1, shard_count):
             raise ValueError(
                 f"shard index {shard_index} out of range for {shard_count}"

@@ -175,6 +175,7 @@ class BlockTwitterSource(BlockParserMixin, TwitterSource):
         num_retweet_end: int = 1000,
         block_bytes: int = 1 << 18,
         flush_seconds: float = 0.5,
+        wire: bool = False,
         **kw,
     ):
         super().__init__(credentials, **kw)
@@ -182,6 +183,9 @@ class BlockTwitterSource(BlockParserMixin, TwitterSource):
         self.end = num_retweet_end
         self.block_bytes = block_bytes
         self.flush_seconds = flush_seconds
+        # zero-copy wire emitter (BlockParserMixin) — same opt-in as the
+        # replay block source
+        self.wire = wire
 
     @classmethod
     def from_properties(cls, **kw) -> "BlockTwitterSource":

@@ -51,6 +51,10 @@
     document.getElementById("fetchDepth").textContent =
       String(gauges["fetch.queue_depth"] || 0);
     // ingest/state robustness (bounded queue + divergence sentinel)
+    // block-parse throughput (ingest.parse_tweets_per_s, tweets/s -> k/s):
+    // the bottleneck ladder's parse rung, live
+    document.getElementById("parseRate").textContent =
+      (Number(gauges["ingest.parse_tweets_per_s"] || 0) / 1000).toFixed(0);
     document.getElementById("queueRows").textContent =
       String(gauges["ingest.queue_rows"] || 0);
     document.getElementById("rowsShed").textContent =
