@@ -65,6 +65,7 @@ def measure(
     repeats: int = REPEATS,
     time_budget_s: float | None = TIME_BUDGET_S,
     settled_after: int = SETTLED_AFTER,
+    tenants: int | None = None,
 ) -> dict:
     import numpy as np  # noqa: F401
 
@@ -74,7 +75,20 @@ def measure(
 
     statuses = list(SyntheticSource(total=n_tweets, seed=3).produce())
     feat = Featurizer(now_ms=1785320000000)
-    model = StreamingLinearRegressionWithSGD()
+    # TWTML_BENCH_TENANTS > 1 runs the headline pipeline through the
+    # multi-tenant model plane (M models, one program, one fetch —
+    # parallel/tenants.py); the tenant count rides the JSON record so a
+    # multi-tenant headline number is never mistaken for the M=1 one
+    tenants = (
+        int(os.environ.get("TWTML_BENCH_TENANTS", "1") or 1)
+        if tenants is None else tenants
+    )
+    if tenants > 1:
+        from twtml_tpu.parallel import TenantStackModel
+
+        model = TenantStackModel(tenants)
+    else:
+        model = StreamingLinearRegressionWithSGD()
 
     from twtml_tpu.utils.benchloop import measure_pipeline
 
@@ -90,8 +104,12 @@ def measure(
         # over 76 interleaved passes, and PACKED into one buffer for
         # another +11.4% paired (per-array request overhead stops hiding
         # once the wire is lean — tools/bench_ragged.py, BENCHMARKS.md)
+        # the tenant plane builds its own routed wire at the model boundary
+        # (TenantStackModel.prepare_wire); the single-model path keeps the
+        # k=1 packed wire
         return feat.featurize_batch_ragged(
-            chunk, row_bucket=batch_size, pre_filtered=True, pack=True
+            chunk, row_bucket=batch_size, pre_filtered=True,
+            pack=(tenants == 1),
         )
 
     out = measure_pipeline(
@@ -99,6 +117,7 @@ def measure(
         time_budget_s=time_budget_s, settled_after=settled_after,
     )
     del out["batches"]
+    out["tenants"] = tenants
     return out
 
 
@@ -180,6 +199,10 @@ def main() -> None:
             # phase flipped — the per-run form of the r2 "health phases"
             # story, so a degraded-budget run explains its own median
             "health": device_result.get("health"),
+            # active tenant count of the measured pipeline (the multi-
+            # tenant model plane, TWTML_BENCH_TENANTS; 1 = the headline
+            # single-model configuration)
+            "tenants": device_result.get("tenants", 1),
         }
     elif cpu_result:
         record = {

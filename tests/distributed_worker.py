@@ -228,6 +228,39 @@ def main() -> None:
             sts, row_bucket=len(sts), token_bucket=64, pre_filtered=True
         )
 
+    if mesh_kind == "tenants":
+        # ISSUE 7: the multi-tenant plane with the TENANT axis mapped onto
+        # the cross-process MODEL axis — device order [p0d0,p1d0,p0d1,p1d1]
+        # pairs processes on the model axis (as in '2d' below), so each
+        # process addresses only HALF the tenants' weight shards and the
+        # latest_weights/stats reads exercise the process_allgather path.
+        # Tenants are independent (no collective crosses the model axis);
+        # rows shard over 'data'. Both hosts featurize the SAME stream
+        # (base_ms pinned) and device_put the same routed stacked wire.
+        from twtml_tpu.parallel import TenantStackModel, make_mesh
+
+        d = jax.devices()
+        mesh = make_mesh(
+            num_data=2, num_model=2, devices=[d[0], d[2], d[1], d[3]]
+        )
+        model = TenantStackModel(
+            4, num_iterations=5, step_size=0.005, mesh=mesh
+        )
+        chunks = [statuses[:32], statuses[32:]]
+        for sts in chunks:
+            out = model.step(feat.featurize_batch_units(
+                sts, row_bucket=32, unit_bucket=64, pre_filtered=True
+            ))
+        gather = TenantStackModel._to_host
+        print(json.dumps({
+            "process": pid,
+            "tenant_counts": gather(out.count).tolist(),
+            "tenant_mses": gather(out.mse).tolist(),
+            "weights_addressable": bool(out.count.is_fully_addressable),
+            "weights": np.asarray(model.latest_weights).tolist(),
+        }), flush=True)
+        return
+
     if mesh_kind == "2d_ckpt":
         # checkpoint round-trip on the cross-process feature-sharded layout:
         # step → gather (process_allgather: shards are NOT fully addressable

@@ -53,6 +53,9 @@ def dashboard(defer_series=False):
         "jsonClass": "Hosts", "hosts": [], "straggler": -1, "stage": "",
         "skewMs": 0.0,
     }
+    h.fetch_routes["/api/tenants"] = {
+        "jsonClass": "Tenants", "tenants": [], "gating": -1, "active": 0,
+    }
     series = h.defer("/api/series") if defer_series else None
     if not defer_series:
         h.fetch_routes["/api/series"] = []
@@ -230,11 +233,54 @@ def test_hosts_frame_builds_tiles_and_names_straggler():
     assert all("gating" not in t.class_set for t in tiles)
 
 
+def test_tenants_frame_builds_tiles_and_highlights_gating():
+    """r10 Tenants tiles (ISSUE 7): one tile per tenant from the model-
+    plane view, the gating (busiest) tenant highlighted, active count
+    shown as active/configured."""
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="Tenants",
+        tenants=[{"tenant": 0, "rows": 1200, "batch": 96, "mse": 1234.5},
+                 {"tenant": 1, "rows": 800, "batch": 0, "mse": -1.0},
+                 {"tenant": 2, "rows": 2100, "batch": 160, "mse": 88.0}],
+        gating=2, active=2,
+    ))
+    assert h.el("tenantsActive").text == "2 / 3"
+    tiles = h.el("tenantsPanel").children
+    assert len(tiles) == 3
+    labels = [t.children[0].text for t in tiles]
+    values = [t.children[1].text for t in tiles]
+    assert labels == ["tenant 0", "tenant 1", "tenant 2 · gating"]
+    # rows localized + mse shown only when finite (-1 = no finite sample)
+    assert values == ["1,200 · mse 1235", "800", "2,100 · mse 88"]
+    assert "gating" in tiles[2].class_set
+    assert all("gating" not in t.class_set for t in tiles[:2])
+    # an all-dry tick clears the highlight
+    h.ws.server_message(frame(
+        jsonClass="Tenants",
+        tenants=[{"tenant": 0, "rows": 1200, "batch": 0, "mse": -1.0}],
+        gating=-1, active=0,
+    ))
+    tiles = h.el("tenantsPanel").children
+    assert all("gating" not in t.class_set for t in tiles)
+
+
+def test_tenants_empty_view_is_placeholder():
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(jsonClass="Tenants", tenants=[], gating=-1,
+                              active=0))
+    assert h.el("tenantsActive").text == "—"
+    assert h.el("tenantsPanel").children == []
+
+
 def test_metrics_backfill_fetched_on_boot():
     h = dashboard()
     urls = [u for u, _ in h.fetches]
     assert "/api/metrics" in urls
     assert "/api/hosts" in urls
+    assert "/api/tenants" in urls
 
 
 def test_unknown_jsonclass_is_ignored():

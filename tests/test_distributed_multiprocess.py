@@ -403,6 +403,42 @@ def test_two_process_2d_mesh_gram_inner_loop():
     np.testing.assert_allclose(outs[0]["weights"], weights, rtol=1e-4, atol=1e-6)
 
 
+def test_two_process_tenants_on_cross_process_model_axis():
+    """ISSUE 7: the multi-tenant plane with the TENANT axis on the
+    cross-process MODEL axis — each process holds half the tenants' weight
+    shards (not fully addressable → the latest_weights allgather runs),
+    rows shard over 'data', and no collective crosses the tenant axis.
+    Both processes must agree exactly with each other AND match a
+    single-process tenant stack over the same stream."""
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.parallel import TenantStackModel
+    from twtml_tpu.streaming.sources import SyntheticSource
+
+    outs = _run_group("unit", mesh="tenants")
+    assert outs[0]["weights_addressable"] is False
+    # cross-host agreement is exact: same program, same placement
+    assert outs[0]["tenant_counts"] == outs[1]["tenant_counts"]
+    assert outs[0]["tenant_mses"] == outs[1]["tenant_mses"]
+    np.testing.assert_array_equal(outs[0]["weights"], outs[1]["weights"])
+
+    statuses = list(
+        SyntheticSource(total=64, seed=7, base_ms=1785320000000).produce()
+    )
+    feat = Featurizer(now_ms=1785320000000)
+    ref = TenantStackModel(4, num_iterations=5, step_size=0.005)
+    for sts in (statuses[:32], statuses[32:]):
+        out = ref.step(feat.featurize_batch_units(
+            sts, row_bucket=32, unit_bucket=64, pre_filtered=True
+        ))
+    assert outs[0]["tenant_counts"] == np.asarray(out.count).tolist()
+    np.testing.assert_allclose(
+        outs[0]["tenant_mses"], np.asarray(out.mse).tolist(), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        outs[0]["weights"], ref.latest_weights, rtol=1e-4, atol=1e-7
+    )
+
+
 def test_app_level_multihost_sentinel_rollback(tmp_path):
     """r7 (ISSUE 4): the divergence sentinel on a REAL two-process group.
     Each host's --chaos source.nan@2 poisons its local rows of the SAME

@@ -49,6 +49,7 @@ CONFIGS = [
     "sharded_dp4",
     "sharded_dp4_logistic",
     "sharded_2e18_2d",
+    "multi_tenant_m8",
 ]
 
 
@@ -71,9 +72,11 @@ def _status_json(s) -> dict:
 
 
 def _pipeline_rate(model, feat, statuses, batch_size, row_multiple=1, shard=None,
-                   ragged=False):
+                   ragged=False, pack=True):
     """The shared double-buffered pipeline (utils/benchloop.py), with the
-    suite's per-config featurizer/shard hooks."""
+    suite's per-config featurizer/shard hooks. ``pack=False`` hands the
+    model the UNPACKED ragged batch — models that build their own wire at
+    the step boundary (the tenant plane's routed stack) need it raw."""
     from twtml_tpu.utils.benchloop import measure_pipeline
 
     chunks = [statuses[i : i + batch_size] for i in range(0, len(statuses), batch_size)]
@@ -85,7 +88,7 @@ def _pipeline_rate(model, feat, statuses, batch_size, row_multiple=1, shard=None
         b = (
             feat.featurize_batch_ragged(
                 chunk, row_bucket=batch_size, pre_filtered=True,
-                row_multiple=row_multiple, pack=True,
+                row_multiple=row_multiple, pack=pack,
             )
             if ragged
             else feat.featurize_batch_units(
@@ -443,6 +446,18 @@ def run_config(name: str, n_tweets: int, batch_size: int = 0) -> dict:
         # r3's --superBatch NEGATIVE finding stands.
         out.update(_pipeline_rate(model, feat, statuses, batch_size,
                                   ragged=True))
+    elif name == "multi_tenant_m8":
+        # the multi-tenant model plane (ISSUE 7): 8 models, one jit
+        # program, one stacked fetch per tick — the per-config rate here;
+        # the PAIRED verdict vs 8 sequential single-tenant pipelines is
+        # tools/bench_tenants.py (interleaved arms, per-round ratios)
+        from twtml_tpu.parallel import TenantStackModel
+
+        feat = Featurizer(now_ms=1785320000000)
+        model = TenantStackModel(8)
+        out.update(_pipeline_rate(model, feat, statuses, batch_size,
+                                  ragged=True, pack=False))
+        out["tenants"] = 8
     elif name in ("sharded_dp4", "sharded_dp4_logistic", "sharded_2e18_2d"):
         from twtml_tpu.parallel import ParallelSGDModel, make_mesh
         from twtml_tpu.parallel.sharding import shard_batch

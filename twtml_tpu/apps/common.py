@@ -345,7 +345,52 @@ def build_model(conf, model_cls=StreamingLinearRegressionWithSGD):
     backend exposes several devices (or local[N] caps a virtual CPU mesh) —
     the CLI face of BASELINE config #5's data-parallel scale-up, for ANY
     SGD-family learner (the class's residual/prediction knobs carry over to
-    the sharded step). Returns (model, required row multiple for batches)."""
+    the sharded step). Returns (model, required row multiple for batches).
+
+    ``--tenants M`` (> 1) swaps in the multi-tenant model plane
+    (parallel/tenants.TenantStackModel): M stacked models in ONE jit
+    program sharing one wire and ONE stacked stats fetch per tick — the
+    marginal tenant costs device FLOPs (µs), not tunnel round trips (the
+    r2 law). Composes with the data mesh (rows P(data), tenant axis
+    replicated); the cross-process tenants-on-model-axis layout is driven
+    at the library level (tests/test_distributed_multiprocess.py) — the
+    app-level multi-host wiring keeps its single-model plane for now."""
+    import jax as _jax
+
+    tenants = int(getattr(conf, "tenants", 1) or 1)
+    # TWTML_FORCE_TENANT_PLANE=1 routes even --tenants 1 through the
+    # stacked program — the app-level M=1 differential-parity hook (the
+    # default path stays the plain single-model plane: a 1-tenant stream
+    # must not pay the routing split)
+    import os as _os
+
+    force_plane = _os.environ.get("TWTML_FORCE_TENANT_PLANE") == "1"
+    if tenants > 1 or (force_plane and tenants == 1):
+        if _jax.process_count() > 1:
+            raise SystemExit(
+                "--tenants is single-host at the app level for now; the "
+                "cross-process tenants-on-model-axis layout is a library "
+                "surface (parallel/tenants.TenantStackModel with a 2D mesh)"
+            )
+        if getattr(conf, "tenantKey", "hash") == "lang" and conf.hashOn != "device":
+            raise SystemExit(
+                "--tenantKey lang routes on raw code units; it requires "
+                "--hashOn device"
+            )
+        from ..parallel.tenants import TenantStackModel
+
+        mesh = build_mesh(conf, what=f"tenant plane ({model_cls.__name__})")
+        model = TenantStackModel.from_conf(
+            conf, mesh,
+            residual_fn=model_cls.residual_fn,
+            prediction_fn=model_cls.prediction_fn,
+            round_predictions=model_cls.round_predictions,
+        )
+        log.info(
+            "multi-tenant model plane: %d tenants, key=%s, wire=%s",
+            tenants, model.tenant_key, model.wire_pack,
+        )
+        return model, (mesh.shape[mesh.axis_names[0]] if mesh else 1)
     mesh = build_mesh(conf, what=f"training ({model_cls.__name__})")
     if mesh is not None:
         from ..parallel import ParallelSGDModel
@@ -1588,8 +1633,43 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
                 return
             sentinel_inner(out, batch, t, at_boundary=at_boundary)
 
+    # a tenant-plane model (any M, the forced M=1 differential included)
+    # carries num_tenants; plain models don't
+    num_tenants = int(getattr(model, "num_tenants", 0) or 0)
+    if num_tenants >= 1:
+        # multi-tenant model plane: the OUTERMOST delivery wrapper — the
+        # fetched [M, ...] StepOutput records the per-tenant view
+        # (telemetry/tenants.py, from arrays already on the host — zero
+        # added fetches) and collapses to ONE batch-level StepOutput in
+        # original row order for the pre-existing chain (sentinel,
+        # session stats, checkpoints). M=1 passes through bit-exact.
+        import numpy as np
+
+        from ..parallel.tenants import aggregate_tenant_output
+        from ..telemetry import tenants as _tenants
+
+        tenant_inner = handle
+
+        def handle(out, batch, t, at_boundary=True):  # noqa: F811
+            _tenants.record_tick(
+                np.asarray(out.count, np.int64),
+                np.asarray(out.mse, np.float64),
+            )
+            tenant_inner(
+                aggregate_tenant_output(out, batch, model), batch, t,
+                at_boundary=at_boundary,
+            )
+
     multihost = jax.process_count() > 1
     k = int(getattr(conf, "superBatch", 1) or 1)
+    if k > 1 and num_tenants >= 1:
+        log.warning(
+            "--superBatch %d ignored with --tenants %d: the tenant stack "
+            "already amortizes the per-tick stats fetch across its %d "
+            "models (scanning K groups of M tenants is future work)",
+            k, num_tenants, num_tenants,
+        )
+        k = 1
     if k > 1 and conf.seconds > 0:
         log.warning(
             "--superBatch %d ignored: wall-clock streaming (--seconds %s) "

@@ -80,7 +80,12 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         ragged=conf.effective_wire() == "ragged",
     )
 
-    totals = {"count": 0, "batches": 0}
+    # tenant count in the run record: callers (bench suite, tests) can see
+    # how many models this run's one jit program trained
+    totals = {
+        "count": 0, "batches": 0,
+        "tenants": int(getattr(model, "num_tenants", 1) or 1),
+    }
 
     # checkpoint/resume (upgrade over the reference, SURVEY.md §5.4)
     ckpt = AppCheckpoint(

@@ -161,6 +161,15 @@ class ConfArguments:
                 f"{self.wirePack!r}"
             )
         self.recycleAfterMb: int = int(conf.get("recycleAfterMb", "0"))
+        # multi-tenant model plane (r10): M models, one jit program, one fetch
+        self.tenants: int = int(conf.get("tenants", "1"))
+        if self.tenants < 1:
+            raise ValueError(f"tenants must be >= 1, got {self.tenants}")
+        self.tenantKey: str = conf.get("tenantKey", "hash")
+        if self.tenantKey not in ("hash", "lang"):
+            raise ValueError(
+                f"tenantKey must be 'hash' or 'lang', got {self.tenantKey!r}"
+            )
         # ingest/state robustness layer (r7)
         self.maxQueueRows: int = int(conf.get("maxQueueRows", "0"))
         self.shedPolicy: str = conf.get("shedPolicy", "block")
@@ -311,6 +320,21 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                                                dispatch (one scan, one stats fetch; per-batch
                                                stats preserved; stops/checkpoints land on group
                                                boundaries). Default: {self.superBatch}
+  --tenants <int M>                            Multi-tenant model plane: train M models
+                                               (per-topic/per-language/per-A/B-arm) in ONE
+                                               jit program — rows route to tenants on the
+                                               host, the M per-tenant batches ship as one
+                                               shared wire (the K-batch superbatch wire
+                                               reused as the K-tenant wire; dry tenants ride
+                                               all-padding batches), and all M tenants'
+                                               stats come back in ONE stacked fetch.
+                                               Per-tenant semantics stay byte-identical to
+                                               the single-model path. Default: {self.tenants}
+  --tenantKey <hash|lang>                      Tenant routing key: 'hash' = deterministic
+                                               content hash (A/B-arm style uniform split);
+                                               'lang' = script-class heuristic from the
+                                               text's code units (per-language scenarios;
+                                               needs --hashOn device). Default: {self.tenantKey}
   --maxQueueRows <int rows>                    Bounded intake backpressure: cap the source→
                                                batcher queue at this many ROWS. 0 = auto
                                                (8 x --batchBucket when pinned, else unbounded);
@@ -455,6 +479,14 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                 self.printUsage(1)
         elif flag == "--recycleAfterMb":
             self.recycleAfterMb = int(take())
+        elif flag == "--tenants":
+            self.tenants = int(take())
+            if self.tenants < 1:
+                self.printUsage(1)
+        elif flag == "--tenantKey":
+            self.tenantKey = take()
+            if self.tenantKey not in ("hash", "lang"):
+                self.printUsage(1)
         elif flag == "--maxQueueRows":
             self.maxQueueRows = int(take())
         elif flag == "--shedPolicy":

@@ -838,6 +838,165 @@ def unpack_batch(buffer, layout: tuple):
     return cls(*fields)
 
 
+# ---- multi-tenant routing (ISSUE 7) ---------------------------------------
+# The tenant plane splits one featurized batch's VALID rows into M per-tenant
+# batches of the SAME padded shape (one wire signature — the lockstep
+# invariant extended to tenants: dry tenants ship all-padding batches so the
+# collective/jit program is identical every tick), then reuses the K-batch
+# superbatch wire (stack_batches / pack_ragged_group) as the K-tenant wire.
+# Routing is a pure deterministic function of the batch, so the delivery-side
+# split (per-tenant stats, prediction re-ordering) recomputes it instead of
+# carrying a permutation through the fetch pipeline.
+
+def _splitmix(x: np.ndarray) -> np.ndarray:
+    """SplitMix64 finalizer over uint64 lanes — a routing mixer, not a
+    cryptographic hash (uniform-ish A/B-arm splits from weak row sums)."""
+    x = x ^ (x >> np.uint64(33))
+    x = x * np.uint64(0xFF51AFD7ED558CCD)
+    return x ^ (x >> np.uint64(33))
+
+
+def _ragged_row_sums(units: np.ndarray, offsets: np.ndarray):
+    """(per-row unit sums, per-row lengths) of a FLAT ragged buffer —
+    cumsum-based so the host pass stays vectorized."""
+    offs = np.asarray(offsets, np.int64)
+    u = np.asarray(units, np.uint64)
+    c = np.zeros((u.shape[0] + 1,), np.uint64)
+    np.cumsum(u, out=c[1:])
+    return c[offs[1:]] - c[offs[:-1]], (offs[1:] - offs[:-1])
+
+
+def tenant_route_keys(
+    batch, num_tenants: int, mode: str = "hash"
+) -> np.ndarray:
+    """Per-row tenant id [B] for a host batch — the cheap host-side routing
+    key of the multi-tenant plane (``--tenantKey``).
+
+    ``hash``: SplitMix64 over (unit-sum, length) per row — a uniform
+    A/B-arm style split, content-deterministic on every wire (FeatureBatch
+    rows key off their hashed-token sums instead of raw units).
+    ``lang``: a script-class heuristic from the row's max code unit (0 for
+    pure-ASCII rows, else keyed by the max unit's high byte) — the
+    per-language/per-script scenario axis; requires a raw-units wire
+    (device hashing), because host-hashed tokens carry no script signal.
+
+    Padding rows get tenant 0 (they are masked out of every tenant batch
+    anyway). Keys are heuristic ROUTING, not semantics: each tenant's model
+    math on its routed rows stays byte-identical to the reference
+    single-model path (PARITY.md)."""
+    m = np.uint64(num_tenants)
+    if isinstance(batch, RaggedUnitBatch):
+        if batch.num_shards != 1:
+            raise ValueError(
+                "route before shard alignment (tenant batches are "
+                "shard-aligned per tenant afterwards)"
+            )
+        sums, lengths = _ragged_row_sums(batch.units, batch.offsets)
+        if mode == "lang":
+            units = np.asarray(batch.units, np.uint64)
+            offs = np.asarray(batch.offsets, np.int64)
+            if units.shape[0] == 0:
+                maxs = np.zeros(lengths.shape, np.uint64)
+            else:
+                safe = np.minimum(offs[:-1], units.shape[0] - 1)
+                maxs = np.maximum.reduceat(units, safe)
+            maxs = np.where(lengths > 0, maxs, np.uint64(0))
+            cls = np.where(
+                maxs < 128, np.uint64(0), np.uint64(1) + (maxs >> np.uint64(8))
+            )
+            return (cls % m).astype(np.int32)
+    elif isinstance(batch, UnitBatch):
+        units = np.asarray(batch.units, np.uint64)
+        sums = units.sum(axis=1)
+        lengths = np.asarray(batch.length, np.uint64)
+        if mode == "lang":
+            maxs = units.max(axis=1) if units.shape[1] else np.zeros_like(sums)
+            cls = np.where(
+                maxs < 128, np.uint64(0), np.uint64(1) + (maxs >> np.uint64(8))
+            )
+            return (cls % m).astype(np.int32)
+    elif isinstance(batch, FeatureBatch):
+        if mode == "lang":
+            raise ValueError(
+                "--tenantKey lang needs a raw-units wire (--hashOn device); "
+                "host-hashed tokens carry no script signal"
+            )
+        sums = np.asarray(batch.token_idx, np.int64).astype(np.uint64).sum(axis=1)
+        lengths = (np.asarray(batch.token_val) != 0).sum(axis=1).astype(np.uint64)
+    else:
+        raise TypeError(f"cannot route a {type(batch).__name__}")
+    if mode != "hash":
+        raise ValueError(f"tenant key mode must be 'hash' or 'lang', got {mode!r}")
+    x = (
+        sums.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+        + lengths.astype(np.uint64) * np.uint64(0xBF58476D1CE4E5B9)
+    )
+    return (_splitmix(x) % m).astype(np.int32)
+
+
+def tenant_rows(batch, tenant_ids: np.ndarray, num_tenants: int):
+    """Per-tenant original-row indices [list of M int arrays], valid rows
+    only, ascending (original relative order preserved within each tenant —
+    the parity law's ordering holds on each tenant's sub-stream)."""
+    valid = np.asarray(batch.mask) > 0
+    ids = np.where(valid, np.asarray(tenant_ids), -1)
+    return [np.nonzero(ids == m)[0] for m in range(num_tenants)]
+
+
+def split_batch_tenants(batch, tenant_ids: np.ndarray, num_tenants: int):
+    """One featurized batch → M per-tenant batches of the SAME padded shape
+    (same row bucket, same units buffer / token shape, same row_len), valid
+    rows routed by ``tenant_ids`` and packed to the front in original
+    relative order; dry tenants come back all-padding. The M batches share
+    one wire signature by construction, so ``stack_batches`` /
+    ``pack_ragged_group`` turn them into the one-tenant-wire upload."""
+    rows_per = tenant_rows(batch, tenant_ids, num_tenants)
+    if isinstance(batch, RaggedUnitBatch):
+        units = np.asarray(batch.units)
+        offs = np.asarray(batch.offsets, np.int64)
+        lengths = offs[1:] - offs[:-1]
+        b = batch.mask.shape[0]
+        out = []
+        for rows in rows_per:
+            lens_m = lengths[rows]
+            total = int(lens_m.sum())
+            units_m = np.zeros_like(units)
+            cml = np.zeros((rows.shape[0] + 1,), np.int64)
+            np.cumsum(lens_m, out=cml[1:])
+            if total:
+                idx = (
+                    np.arange(total, dtype=np.int64)
+                    - np.repeat(cml[:-1], lens_m)
+                    + np.repeat(offs[rows], lens_m)
+                )
+                units_m[:total] = units[idx]
+            offs_m = np.full((b + 1,), total, np.int32)
+            offs_m[: rows.shape[0] + 1] = cml.astype(np.int32)
+            numeric = np.zeros_like(np.asarray(batch.numeric))
+            label = np.zeros_like(np.asarray(batch.label))
+            mask = np.zeros_like(np.asarray(batch.mask))
+            n = rows.shape[0]
+            numeric[:n] = np.asarray(batch.numeric)[rows]
+            label[:n] = np.asarray(batch.label)[rows]
+            mask[:n] = 1.0
+            out.append(RaggedUnitBatch(
+                units_m, offs_m, numeric, label, mask,
+                row_len=batch.row_len, num_shards=1,
+            ))
+        return out
+    out = []
+    for rows in rows_per:
+        n = rows.shape[0]
+        fields = []
+        for arr in batch:
+            arr = np.asarray(arr)
+            dest = np.zeros_like(arr)
+            dest[:n] = arr[rows]
+            fields.append(dest)
+        out.append(type(batch)(*fields))
+    return out
+
+
 def stack_batches(batches):
     """K same-shape batches → one batch whose arrays carry a leading [K]
     axis — the superbatch wire format for ``StreamingSGDModel.step_many``

@@ -1,11 +1,14 @@
 from .mesh import make_mesh, default_mesh
 from .sharding import ParallelSGDModel, batch_pspecs, shard_batch
+from .tenants import TenantStackModel, split_tenant_output
 from . import distributed
 
 __all__ = [
     "make_mesh",
     "default_mesh",
     "ParallelSGDModel",
+    "TenantStackModel",
+    "split_tenant_output",
     "batch_pspecs",
     "shard_batch",
     "distributed",

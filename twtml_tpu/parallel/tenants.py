@@ -1,0 +1,532 @@
+"""Multi-tenant model plane: M models, ONE jit program, ONE fetch (ISSUE 7).
+
+The reference trains one global retweet model; the scenario axis (per-topic /
+per-language / per-A/B-arm) would naively cost M full pipelines — M wires, M
+dispatches, and above all M host fetches at ~70–100 ms RTT each (the r2 law:
+fetches, not arrays, are what cost). This module stacks M models along a
+leading tenant axis so the marginal tenant costs device FLOPs (µs, nowhere
+near binding on the measured ladder) instead of tunnel round trips:
+
+- **weights** are one ``[M, F+4]`` array (one optimizer-state pytree; one
+  donated buffer), per-tenant hyperparams (step size, L2) ride as mapped
+  scalar leaves of a separate ``hyper`` pytree;
+- **the step** maps the EXISTING fused SGD step over the tenant axis.
+  Default mapping is ``lax.map`` — a scan of the single-tenant step program
+  with no carry, which keeps every tenant's math BIT-IDENTICAL to the
+  reference single-model path (the parity law; ``step_many`` uses the same
+  trick over K batches). ``mapping="vmap"`` batches the tenants across the
+  device instead — mathematically equivalent, but XLA's batched-matmul
+  accumulation order differs on the dense path, so it is an opt-in for
+  deployments that trade bit-parity for device parallelism (device compute
+  is µs either way; the win of this plane is fetch amortization, not FLOPs);
+- **the wire** is shared: rows route to tenants on the host by a cheap
+  deterministic key (``features/batch.tenant_route_keys``), split into M
+  same-signature batches (dry tenants = all-padding, the lockstep
+  invariant), and ship as the K-batch superbatch wire reused as the
+  K-tenant wire — ``stack_batches`` (``--wirePack stacked``) or the
+  coalesced one-buffer ``pack_ragged_group`` (``--wirePack group``);
+- **the fetch** is one ``jax.device_get`` of the ``[M, ...]`` StepOutput
+  through the existing FetchPipeline — fetch count per tick is ONE
+  regardless of M (asserted by the counting tests).
+
+Mesh composition: a 1D ('data',) mesh shards every tenant batch's rows over
+``data`` (tenant axis unsharded — weights replicated) with the per-shard
+body's psums riding the existing collectives; a 2D ('data','model') mesh
+maps the TENANT axis onto ``model`` (the cross-process model axis proven in
+parallel/distributed.py + tests/test_distributed_multiprocess.py): each
+model shard holds M/num_model tenants' weights and maps only those — tenant
+independence means NO collective ever crosses the model axis.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..features.batch import (
+    NUM_NUMBER_FEATURES,
+    PackedBatch,
+    RaggedUnitBatch,
+    pack_ragged_group,
+    split_batch_tenants,
+    stack_batches,
+    tenant_route_keys,
+    unpack_batch,
+)
+from ..models.base import StepOutput
+from ..models.sgd import make_sgd_train_step
+from ..utils import get_logger
+
+log = get_logger("parallel.tenants")
+
+
+def aggregate_tenant_output(out, batch, model) -> StepOutput:
+    """The delivered ``[M, ...]`` StepOutput → ONE batch-level StepOutput in
+    the ORIGINAL batch's row order, for the app handler / sentinel /
+    session-stats chain that predates tenants. Pure host numpy on the
+    already-fetched arrays — zero added device work or fetches.
+
+    M = 1 passes tenant 0's output through untouched (bit-exact — the M=1
+    parity law). M > 1 aggregates: ``count`` sums; ``mse`` is the
+    row-weighted mean of per-tenant mses (exact — mse is a per-row mean);
+    the stdevs are row-weighted POOLED within-tenant stdevs (each tenant is
+    an independent model, so a cross-tenant stdev is not a reference
+    quantity; the pooled form is documented in PARITY.md). ``predictions``
+    re-order to original rows via the deterministic routing key — the same
+    route the wire used, recomputed instead of carried through the fetch
+    pipeline. A non-finite stat in ANY tenant propagates into the
+    aggregate, so the divergence sentinel still sees every poisoning."""
+    from ..features.batch import tenant_rows
+
+    m = model.num_tenants
+    if m == 1:
+        return StepOutput(*(
+            None if f is None else f[0] for f in out
+        ))
+    counts = np.asarray(out.count, np.float64)
+    total = float(counts.sum())
+    denom = max(total, 1.0)
+    mse = float((counts * np.asarray(out.mse, np.float64)).sum() / denom)
+    real_sd = float(np.sqrt(
+        (counts * np.square(np.asarray(out.real_stdev, np.float64))).sum()
+        / denom
+    ))
+    pred_sd = float(np.sqrt(
+        (counts * np.square(np.asarray(out.pred_stdev, np.float64))).sum()
+        / denom
+    ))
+    preds = None
+    if out.predictions is not None:
+        tenant_preds = np.asarray(out.predictions)
+        preds = np.zeros(tenant_preds.shape[1:], tenant_preds.dtype)
+        rows_per = tenant_rows(batch, model.route_ids(batch), m)
+        for i, rows in enumerate(rows_per):
+            preds[rows] = tenant_preds[i][: rows.shape[0]]
+    return StepOutput(
+        predictions=preds,
+        count=np.float32(total),
+        mse=np.float32(mse),
+        real_stdev=np.float32(real_sd),
+        pred_stdev=np.float32(pred_sd),
+    )
+
+
+def split_tenant_output(out: StepOutput, num_tenants: int):
+    """Host-side split of the ONE fetched ``[M, ...]`` StepOutput into M
+    per-tenant StepOutputs (plain numpy views — no further host fetch)."""
+    return [
+        StepOutput(*(
+            None if f is None else f[m] for f in out
+        ))
+        for m in range(num_tenants)
+    ]
+
+
+class TenantStackModel:
+    """M stacked streaming-SGD learners with the single-model step surface
+    the pipelines consume (``step``/``latest_weights``/``set_initial_weights``
+    /``prepare``/``pack_for_wire``), so FetchPipeline, checkpoints, the
+    divergence sentinel and the lockstep scheduler all work unchanged.
+
+    ``step(batch)`` accepts an ORDINARY featurized host batch: it routes the
+    rows (``tenant_route_keys`` → ``split_batch_tenants``), builds the
+    stacked/coalesced tenant wire, and runs the one mapped jit program;
+    the returned StepOutput carries ``[M]``-leading leaves (``[M, B]``
+    predictions in per-tenant row order — ``route_ids`` re-derives the
+    original-row permutation on the host). A pre-routed wire (a stacked
+    batch from ``prepare_wire`` or a PackedBatch from ``pack_for_wire``)
+    passes straight through — the pack happens once, at the model boundary,
+    exactly like the single-tenant packed wire."""
+
+    accepts_packed = True
+
+    def __init__(
+        self,
+        num_tenants: int,
+        num_text_features: int = 1000,
+        num_iterations: int = 50,
+        step_size: float = 0.1,
+        mini_batch_fraction: float = 1.0,
+        l2_reg: float = 0.0,
+        convergence_tol: float = 0.001,
+        dtype=jnp.float32,
+        residual_fn: Callable | None = None,
+        prediction_fn: Callable | None = None,
+        round_predictions: bool = True,
+        use_sparse: bool | None = None,
+        use_gram: bool | None = None,
+        gram_int8: bool | None = None,
+        tenant_key: str = "hash",
+        wire_pack: str = "stacked",
+        mesh=None,
+        step_sizes=None,
+        l2_regs=None,
+        mapping: str = "scan",
+    ) -> None:
+        if num_tenants < 1:
+            raise ValueError(f"num_tenants must be >= 1, got {num_tenants}")
+        if mapping not in ("scan", "vmap"):
+            raise ValueError(f"mapping must be 'scan' or 'vmap', got {mapping!r}")
+        if wire_pack not in ("stacked", "group"):
+            raise ValueError(
+                f"wire_pack must be 'stacked' or 'group', got {wire_pack!r}"
+            )
+        self.num_tenants = num_tenants
+        self.num_text_features = num_text_features
+        self.dtype = dtype
+        self.tenant_key = tenant_key
+        self.wire_pack = wire_pack
+        self.mapping = mapping
+        self.mesh = mesh
+        f_total = num_text_features + NUM_NUMBER_FEATURES
+
+        # per-tenant hyperparams as MAPPED scalar leaves: they are consumed
+        # only inside jnp arithmetic (eta = step/√i, the L2 pre-scale), so a
+        # traced per-tenant scalar flows through the existing step builder
+        # unchanged. Structural knobs (num_iterations, miniBatchFraction,
+        # convergenceTol) stay shared — they shape the compiled program.
+        def _vec(v, default):
+            if v is None:
+                return jnp.full((num_tenants,), default, dtype)
+            v = jnp.asarray(v, dtype)
+            if v.shape != (num_tenants,):
+                raise ValueError(
+                    f"per-tenant hyperparam needs shape ({num_tenants},), "
+                    f"got {v.shape}"
+                )
+            return v
+
+        self._hyper = {
+            "step_size": _vec(step_sizes, step_size),
+            "l2_reg": _vec(l2_regs, l2_reg),
+        }
+
+        def one(weights, hyper, batch):
+            # build the EXISTING fused step with this tenant's (traced)
+            # hyperparams closed over — the parity-critical semantics live
+            # in models/sgd.py exactly once
+            step = make_sgd_train_step(
+                num_text_features=num_text_features,
+                num_iterations=num_iterations,
+                step_size=hyper["step_size"],
+                mini_batch_fraction=mini_batch_fraction,
+                l2_reg=hyper["l2_reg"],
+                convergence_tol=convergence_tol,
+                residual_fn=residual_fn,
+                prediction_fn=prediction_fn,
+                round_predictions=round_predictions,
+                axis_name=self._data_axis,
+                use_sparse=use_sparse,
+                use_gram=use_gram,
+                gram_int8=gram_int8,
+            )
+            return step(weights, batch)
+
+        self._one = one
+        self._weights = jnp.zeros((num_tenants, f_total), dtype)
+        self._progs: dict = {}
+        if mesh is not None:
+            self._init_mesh(mesh)
+
+    # -- mesh plumbing ------------------------------------------------------
+    @property
+    def _data_axis(self):
+        return self.mesh.axis_names[0] if self.mesh is not None else None
+
+    @property
+    def _tenant_axis(self):
+        """The mesh axis the TENANT dim shards over: the 'model' axis of a
+        2D mesh (cross-process tenants), None on 1D (replicated)."""
+        if self.mesh is not None and len(self.mesh.axis_names) > 1:
+            return self.mesh.axis_names[1]
+        return None
+
+    def _init_mesh(self, mesh) -> None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        t_axis = self._tenant_axis
+        self.num_data = mesh.shape[self._data_axis]
+        if t_axis is not None:
+            n_t = mesh.shape[t_axis]
+            if self.num_tenants % n_t:
+                raise ValueError(
+                    f"{self.num_tenants} tenants not divisible by the "
+                    f"mesh's {t_axis} axis ({n_t})"
+                )
+            w_spec = P(t_axis, None)
+            self._weights = jax.device_put(
+                np.asarray(self._weights), NamedSharding(mesh, w_spec)
+            )
+            self._hyper = jax.device_put(
+                self._hyper,
+                NamedSharding(mesh, P(t_axis)),
+            )
+            self._w_spec, self._h_spec = w_spec, P(t_axis)
+            self._out_specs = StepOutput(
+                predictions=P(t_axis, self._data_axis),
+                count=P(t_axis), mse=P(t_axis),
+                real_stdev=P(t_axis), pred_stdev=P(t_axis),
+            )
+        else:
+            self._w_spec, self._h_spec = P(), P()
+            self._out_specs = StepOutput(
+                predictions=P(None, self._data_axis),
+                count=P(), mse=P(), real_stdev=P(), pred_stdev=P(),
+            )
+
+    def _batch_spec(self, batch_cls):
+        from jax.sharding import PartitionSpec as P
+
+        from .sharding import _pspecs_for, _stacked
+
+        t_axis = self._tenant_axis
+        if batch_cls is PackedBatch:
+            # the coalesced tenant wire is shard-major ([S, M, seg] flat):
+            # P(data) hands each device its own M segments (1D mesh only —
+            # the 2D tenant layout ships the stacked wire)
+            return P(self._data_axis)
+        spec = _stacked(_pspecs_for(batch_cls, self._data_axis))
+        if t_axis is not None:
+            # tenants over the model axis: replace the leading None
+            spec = jax.tree_util.tree_map(
+                lambda s: P(*((t_axis,) + tuple(s)[1:])),
+                spec, is_leaf=lambda x: isinstance(x, P),
+            )
+        return spec
+
+    # -- the one mapped program ---------------------------------------------
+    def _mapped(self, weights, hyper, batch):
+        if isinstance(batch, PackedBatch):
+            # coalesced tenant wire (pack_ragged_group): rebuild the
+            # stacked [M, ...] leaves in-program — zero-copy bitcasts
+            batch = unpack_batch(batch.buffer, batch.layout)
+        if self.mapping == "vmap":
+            return jax.vmap(self._one)(weights, hyper, batch)
+        # lax.map = scan of the single-tenant step with no carry: the SAME
+        # program per tenant, hence bit-identical math (the parity law)
+        return lax.map(lambda args: self._one(*args), (weights, hyper, batch))
+
+    def _prog_for(self, batch_cls) -> Callable:
+        fn = self._progs.get(batch_cls)
+        if fn is None:
+            if self.mesh is None:
+                fn = jax.jit(self._mapped, donate_argnums=0)
+            else:
+                from ..utils import shard_map
+
+                sharded = shard_map()(
+                    self._mapped,
+                    mesh=self.mesh,
+                    in_specs=(
+                        self._w_spec, self._h_spec,
+                        self._batch_spec(batch_cls),
+                    ),
+                    out_specs=(self._w_spec, self._out_specs),
+                )
+                fn = jax.jit(sharded, donate_argnums=0)
+            self._progs[batch_cls] = fn
+        return fn
+
+    # -- routing + wire ------------------------------------------------------
+    def route_ids(self, batch) -> np.ndarray:
+        """Per-row tenant ids for a host batch — deterministic, so delivery-
+        side consumers (per-tenant stats, prediction re-ordering) recompute
+        it instead of threading a permutation through the fetch pipeline."""
+        return tenant_route_keys(batch, self.num_tenants, self.tenant_key)
+
+    def split(self, batch):
+        """Route + split into the M same-signature tenant batches."""
+        return split_batch_tenants(
+            batch, self.route_ids(batch), self.num_tenants
+        )
+
+    def _is_tenant_wire(self, batch) -> bool:
+        if isinstance(batch, PackedBatch):
+            return True
+        mask = getattr(batch, "mask", None)
+        return mask is not None and getattr(mask, "ndim", 1) == 2
+
+    def prepare_wire(self, batch):
+        """Host batch → the stacked/coalesced M-tenant wire (the K-batch
+        group wire reused with K = M tenants). ``--wirePack group``
+        coalesces the M ragged batches into ONE contiguous buffer (one
+        main-thread put, uint16-delta offsets); ``stacked`` ships M
+        per-field arrays. Bit-identical math either way (the superbatch
+        wire law, tests/test_superwire.py)."""
+        return self.prepare_wire_from_parts(self.split(batch))
+
+    def prepare_wire_from_parts(self, parts):
+        """The wire-layout half of ``prepare_wire`` for callers that route
+        themselves (tests, custom routers): M same-signature per-tenant
+        batches → the stacked/coalesced tenant wire."""
+        if self.mesh is not None:
+            # ragged parts shard-align to the data axis BEFORE stacking
+            # (alignment is a flat-batch operation — the superbatch rule)
+            parts = [self._prepare_part(p) for p in parts]
+        if (
+            self.wire_pack == "group"
+            and isinstance(parts[0], RaggedUnitBatch)
+            # the coalesced shard-major buffer has no tenant-axis layout;
+            # the 2D (tenants-on-model-axis) plane ships the stacked wire
+            and self._tenant_axis is None
+        ):
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                pb = pack_ragged_group(parts)
+                return PackedBatch(
+                    jax.device_put(
+                        pb.buffer,
+                        NamedSharding(self.mesh, P(self._data_axis)),
+                    ),
+                    pb.layout,
+                )
+            return pack_ragged_group(parts)
+        return stack_batches(parts)
+
+    def _prepare_part(self, part):
+        from ..features.batch import align_ragged_shards
+
+        if (
+            isinstance(part, RaggedUnitBatch)
+            and part.num_shards != self.num_data
+        ):
+            return align_ragged_shards(part, self.num_data)
+        return part
+
+    # FetchPipeline's pack hook: the tenant wire IS the pack (one routed
+    # wire per batch, built once at the model boundary)
+    def pack_for_wire(self, batch):
+        return self.prepare_wire(batch)
+
+    # -- model surface -------------------------------------------------------
+    def step(self, batch) -> StepOutput:
+        wire = batch if self._is_tenant_wire(batch) else self.prepare_wire(batch)
+        if self.mesh is not None and not isinstance(
+            jax.tree_util.tree_leaves(wire)[0], jax.Array
+        ):
+            wire = self._place(wire)
+        self._weights, out = self._prog_for(type(wire))(
+            self._weights, self._hyper, wire
+        )
+        return out
+
+    def _place(self, wire):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if isinstance(wire, PackedBatch):
+            return PackedBatch(
+                jax.device_put(
+                    wire.buffer, NamedSharding(self.mesh, P(self._data_axis))
+                ),
+                wire.layout,
+            )
+        if self._tenant_axis is None:
+            # 1D mesh: tenants unsharded, rows over data — exactly the
+            # stacked-superbatch placement shard_batch already implements
+            from .sharding import shard_batch
+
+            return shard_batch(wire, self.mesh)
+        spec = self._batch_spec(type(wire))
+        if isinstance(wire, RaggedUnitBatch):
+            sharding = NamedSharding(self.mesh, spec)  # one prefix spec
+            return RaggedUnitBatch(
+                *(jax.device_put(a, sharding) for a in (
+                    wire.units, wire.offsets, wire.numeric, wire.label,
+                    wire.mask,
+                )),
+                row_len=wire.row_len, num_shards=wire.num_shards,
+            )
+        return type(wire)(*(
+            jax.device_put(a, NamedSharding(self.mesh, s))
+            for a, s in zip(
+                wire,
+                jax.tree_util.tree_leaves(
+                    spec, is_leaf=lambda x: isinstance(x, P)
+                ),
+            )
+        ))
+
+    @staticmethod
+    def _to_host(arr) -> np.ndarray:
+        if (
+            isinstance(arr, jax.Array)
+            and not arr.is_fully_addressable
+            and not arr.is_fully_replicated
+        ):
+            from jax.experimental import multihost_utils
+
+            return np.asarray(
+                multihost_utils.process_allgather(arr, tiled=True)
+            )
+        return np.asarray(arr)
+
+    @property
+    def latest_weights(self) -> np.ndarray:
+        """[M, F+4] — one checkpointable array for all tenants."""
+        return self._to_host(self._weights)
+
+    def tenant_weights(self, m: int) -> np.ndarray:
+        return self.latest_weights[m]
+
+    def set_initial_weights(self, weights) -> "TenantStackModel":
+        """Accepts the stacked [M, F+4] state (checkpoint restore) or one
+        flat [F+4] vector broadcast to every tenant (the sentinel's
+        zeros-reset, and MLlib-style shared initial weights)."""
+        weights = np.asarray(weights, dtype=self.dtype)
+        if weights.ndim == 1:
+            weights = np.broadcast_to(
+                weights, (self.num_tenants,) + weights.shape
+            ).copy()
+        if weights.shape[0] != self.num_tenants:
+            raise ValueError(
+                f"stacked weights lead with {weights.shape[0]} tenants; "
+                f"this plane has {self.num_tenants}"
+            )
+        if self.mesh is not None and self._tenant_axis is not None:
+            from jax.sharding import NamedSharding
+
+            sharding = NamedSharding(self.mesh, self._w_spec)
+            self._weights = jax.make_array_from_callback(
+                weights.shape, sharding, lambda idx: weights[idx]
+            )
+        else:
+            self._weights = jnp.asarray(weights)
+        return self
+
+    def reset(self) -> "TenantStackModel":
+        return self.set_initial_weights(
+            np.zeros(
+                (self.num_text_features + NUM_NUMBER_FEATURES,), np.float32
+            )
+        )
+
+    @classmethod
+    def from_conf(cls, conf, mesh=None, **overrides):
+        kwargs = dict(
+            num_tenants=int(getattr(conf, "tenants", 1) or 1),
+            num_text_features=conf.numTextFeatures,
+            num_iterations=conf.numIterations,
+            step_size=conf.stepSize,
+            mini_batch_fraction=conf.miniBatchFraction,
+            l2_reg=conf.l2Reg,
+            convergence_tol=conf.convergenceTol,
+            dtype=jnp.dtype(conf.dtype),
+            tenant_key=getattr(conf, "tenantKey", "hash"),
+            wire_pack=(
+                "group"
+                if getattr(conf, "effective_wire_pack", lambda: "stacked")()
+                == "group" and conf.effective_wire() == "ragged"
+                else "stacked"
+            ),
+            mesh=mesh,
+        )
+        kwargs.update(overrides)
+        return cls(**kwargs)
+
+    def train_on(self, stream) -> None:
+        stream.foreach_batch(lambda batch, _time: self.step(batch))

@@ -89,8 +89,24 @@ class Hosts:
     json_class = "Hosts"
 
 
+@dataclass
+class Tenants:
+    """Per-tenant model-plane view — an ADDITIVE message type (no reference
+    equivalent; the reference trains ONE model). One row per tenant from
+    the stacked StepOutput the pipeline already fetched (telemetry/
+    tenants.py), plus the gating tenant (most rows this tick — where the
+    shared row bucket binds first) and the active-tenant count. Legacy
+    dashboards ignore it like Series/Metrics/Hosts."""
+
+    tenants: list = field(default_factory=list)
+    gating: int = -1
+    active: int = 0
+
+    json_class = "Tenants"
+
+
 TYPES = {"Config": Config, "Stats": Stats, "Series": Series,
-         "Metrics": Metrics, "Hosts": Hosts}
+         "Metrics": Metrics, "Hosts": Hosts, "Tenants": Tenants}
 
 
 def encode(obj: Config | Stats) -> str:

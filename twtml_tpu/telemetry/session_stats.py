@@ -206,14 +206,28 @@ class SessionStats:
             self._web_breaker.record_failure()
             log.debug("web.metrics failed", exc_info=True)
         view = _sideband.last_hosts()
-        if view is None or not self._web_breaker.allow():
-            return
-        try:
-            self.web.hosts(
-                view["hosts"], view["straggler"], view["stage"],
-                view["skew_ms"],
-            )
-            self._web_breaker.record_success()
-        except Exception:
-            self._web_breaker.record_failure()
-            log.debug("web.hosts failed", exc_info=True)
+        if view is not None and self._web_breaker.allow():
+            try:
+                self.web.hosts(
+                    view["hosts"], view["straggler"], view["stage"],
+                    view["skew_ms"],
+                )
+                self._web_breaker.record_success()
+            except Exception:
+                self._web_breaker.record_failure()
+                log.debug("web.hosts failed", exc_info=True)
+        # per-tenant model-plane view (telemetry/tenants.py — recorded by
+        # the tenant handle adapter from the already-fetched stacked
+        # StepOutput; empty on single-tenant runs)
+        from . import tenants as _tenants
+
+        tview = _tenants.last_tenants()
+        if tview is not None and self._web_breaker.allow():
+            try:
+                self.web.tenants(
+                    tview["tenants"], tview["gating"], tview["active"],
+                )
+                self._web_breaker.record_success()
+            except Exception:
+                self._web_breaker.record_failure()
+                log.debug("web.tenants failed", exc_info=True)

@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import urllib.request
 
-from .api_types import Config, Hosts, Metrics, Series, Stats, decode, encode
+from .api_types import (
+    Config, Hosts, Metrics, Series, Stats, Tenants, decode, encode,
+)
 
 DEFAULT_SERVER = "http://localhost:8888"  # WebClient.scala:13
 
@@ -87,6 +89,12 @@ class WebClient:
         Hosts tile row (additive message; telemetry/sideband.py)."""
         self._post(Hosts(hosts=list(hosts), straggler=int(straggler),
                          stage=str(stage), skewMs=float(skew_ms)))
+
+    def tenants(self, tenants: list, gating: int = -1, active: int = 0) -> None:
+        """Push the per-tenant model-plane view for the dashboard's Tenants
+        tile row (additive message; telemetry/tenants.py)."""
+        self._post(Tenants(tenants=list(tenants), gating=int(gating),
+                           active=int(active)))
 
     # -- reads (WebClient.scala:40-46) ---------------------------------------
     def get_config(self) -> Config:

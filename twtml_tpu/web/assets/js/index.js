@@ -98,12 +98,41 @@
     }
   }
 
+  function onTenants(json) {
+    // per-tenant model-plane tiles (telemetry/tenants.py): one tile per
+    // tenant with its last-batch rows + mse; the gating tenant (most rows
+    // this tick — where the shared row bucket binds first) highlighted
+    var tenants = json.tenants || [];
+    document.getElementById("tenantsActive").textContent =
+      tenants.length ? String(json.active || 0) + " / " + tenants.length : "—";
+    const panel = document.getElementById("tenantsPanel");
+    panel.replaceChildren();
+    for (const t of tenants) {
+      const tile = document.createElement("div");
+      tile.className = "stat";
+      const isGating = Number(json.gating) >= 0 && t.tenant === json.gating;
+      if (isGating) tile.classList.add("gating");
+      const label = document.createElement("div");
+      label.className = "label";
+      label.textContent = "tenant " + t.tenant + (isGating ? " · gating" : "");
+      const value = document.createElement("div");
+      value.className = "value";
+      value.textContent =
+        Number(t.rows || 0).toLocaleString() +
+        (t.mse >= 0 ? " · mse " + Math.round(Number(t.mse)) : "");
+      tile.appendChild(label);
+      tile.appendChild(value);
+      panel.appendChild(tile);
+    }
+  }
+
   function onMessage(json) {
     switch (json.jsonClass) {
       case "Config": onConfig(json); break;
       case "Stats": onStats(json); break;
       case "Metrics": onMetrics(json); break;
       case "Hosts": onHosts(json); break;
+      case "Tenants": onTenants(json); break;
       case "Series":
         // live frames buffer until the history backfill lands (ordering)
         if (!backfilled) pendingSeries.push(json);
@@ -128,6 +157,8 @@
     fetch("/api/metrics").then((r) => r.json()).then(onMetrics).catch(() => {});
     // per-host lockstep view backfill (empty hosts[] on single-host runs)
     fetch("/api/hosts").then((r) => r.json()).then(onHosts).catch(() => {});
+    // per-tenant model-plane backfill (empty tenants[] single-tenant)
+    fetch("/api/tenants").then((r) => r.json()).then(onTenants).catch(() => {});
     // backfill the chart from the server's rolling series window, then
     // apply any live frames that arrived while the fetch was in flight
     const flush = () => {

@@ -17,7 +17,7 @@ import collections
 import json as _json
 
 from ..telemetry.api_types import (
-    Config, Hosts, Metrics, Series, Stats, decode, encode,
+    Config, Hosts, Metrics, Series, Stats, Tenants, decode, encode,
 )
 from ..utils import get_logger
 
@@ -36,6 +36,7 @@ class ApiCache:
         self._config = Config()
         self._metrics = Metrics()
         self._hosts = Hosts()
+        self._tenants = Tenants()
         self._series: collections.deque[Series] = collections.deque(
             maxlen=SERIES_WINDOW
         )
@@ -53,6 +54,10 @@ class ApiCache:
     def hosts(self) -> str:
         """Latest per-host lockstep sideband view (in-memory only)."""
         return encode(self._hosts)
+
+    def tenants(self) -> str:
+        """Latest per-tenant model-plane view (in-memory only)."""
+        return encode(self._tenants)
 
     def series(self) -> str:
         """Recent Series messages as a JSON array (chart backfill for
@@ -80,6 +85,8 @@ class ApiCache:
             self._metrics = data
         elif isinstance(data, Hosts):
             self._hosts = data
+        elif isinstance(data, Tenants):
+            self._tenants = data
         elif isinstance(data, Series):
             self._series.append(data)
         else:

@@ -76,6 +76,10 @@ class Server:
     async def _get_hosts(self, request: web.Request) -> web.StreamResponse:
         return web.Response(text=self.cache.hosts(), content_type="application/json")
 
+    async def _get_tenants(self, request: web.Request) -> web.StreamResponse:
+        return web.Response(text=self.cache.tenants(),
+                            content_type="application/json")
+
     async def _ws_api(self, request: web.Request) -> web.StreamResponse:
         ws = web.WebSocketResponse(heartbeat=30)
         await ws.prepare(request)
@@ -165,6 +169,7 @@ class Server:
         app.router.add_get("/api/series", self._get_series)  # chart backfill
         app.router.add_get("/api/metrics", self._get_metrics)  # observability
         app.router.add_get("/api/hosts", self._get_hosts)  # lockstep fleet view
+        app.router.add_get("/api/tenants", self._get_tenants)  # model plane
         app.router.add_get("/", self._index)
         app.router.add_get("/{path:.+}", self._static)
         return app
