@@ -56,6 +56,11 @@ def dashboard(defer_series=False):
     h.fetch_routes["/api/tenants"] = {
         "jsonClass": "Tenants", "tenants": [], "gating": -1, "active": 0,
     }
+    h.fetch_routes["/api/model"] = {
+        "jsonClass": "ModelHealth", "level": "ok", "driftScore": 0.0,
+        "lossTrend": 0.0, "weightNorm": 0.0, "updateNorm": 0.0,
+        "gradNorm": 0.0, "mse": [], "tenants": [], "episodes": 0,
+    }
     series = h.defer("/api/series") if defer_series else None
     if not defer_series:
         h.fetch_routes["/api/series"] = []
@@ -266,6 +271,101 @@ def test_tenants_frame_builds_tiles_and_highlights_gating():
     assert all("gating" not in t.class_set for t in tiles)
 
 
+def test_model_health_frame_updates_tiles_and_level_class():
+    """r11 "model · drift" tiles (ISSUE 8): health badge with graduated
+    level class, drift z / loss-trend / norm values, episode counter."""
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="ModelHealth", level="warn", driftScore=5.26,
+        lossTrend=0.31, weightNorm=122.6, updateNorm=3.14, gradNorm=4400.0,
+        mse=[100.0, 110.0, 130.0], tenants=[], episodes=2,
+    ))
+    assert h.el("modelLevel").text == "warn"
+    assert "warn" in h.el("modelLevel").class_set
+    assert "ok" not in h.el("modelLevel").class_set
+    assert h.el("driftScore").text == "5.3"
+    assert h.el("lossTrend").text == "+31%"
+    assert h.el("weightNorm").text == "122.6"
+    assert h.el("updateNorm").text == "3.14"
+    assert h.el("driftEpisodes").text == "2"
+    # recovery flips the badge class back to ok
+    h.ws.server_message(frame(
+        jsonClass="ModelHealth", level="ok", driftScore=0.4, lossTrend=-0.02,
+        weightNorm=123.0, updateNorm=1.0, gradNorm=4000.0, mse=[100.0],
+        tenants=[], episodes=2,
+    ))
+    assert h.el("modelLevel").text == "ok"
+    assert "ok" in h.el("modelLevel").class_set
+    assert "warn" not in h.el("modelLevel").class_set
+    assert h.el("lossTrend").text == "-2%"
+
+
+def test_model_health_tenant_tiles_highlight_unhealthy():
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="ModelHealth", level="alert", driftScore=9.5, lossTrend=0.0,
+        weightNorm=10.0, updateNorm=1.0, gradNorm=100.0, mse=[1.0],
+        tenants=[{"tenant": 0, "level": "ok", "drift": 0.3},
+                 {"tenant": 1, "level": "alert", "drift": 9.5}],
+        episodes=1,
+    ))
+    tiles = h.el("modelTenantsPanel").children
+    assert len(tiles) == 2
+    labels = [t.children[0].text for t in tiles]
+    values = [t.children[1].text for t in tiles]
+    assert labels == ["tenant 0", "tenant 1"]
+    assert values == ["ok · z 0.3", "alert · z 9.5"]
+    assert "alerting" in tiles[1].class_set
+    assert "alerting" not in tiles[0].class_set
+    # a healthy frame clears the tiles' highlight
+    h.ws.server_message(frame(
+        jsonClass="ModelHealth", level="ok", driftScore=0.2, lossTrend=0.0,
+        weightNorm=10.0, updateNorm=1.0, gradNorm=100.0, mse=[1.0],
+        tenants=[{"tenant": 0, "level": "ok", "drift": 0.2}], episodes=1,
+    ))
+    tiles = h.el("modelTenantsPanel").children
+    assert all("alerting" not in t.class_set for t in tiles)
+
+
+def test_model_health_loss_sparkline_draws():
+    h = dashboard()
+    h.ws.server_open()
+    ctx = h.el("lossSpark").ctx
+    ctx.calls.clear()
+    h.ws.server_message(frame(
+        jsonClass="ModelHealth", level="ok", driftScore=0.0, lossTrend=0.0,
+        weightNorm=1.0, updateNorm=1.0, gradNorm=1.0,
+        mse=[100.0, 120.0, 90.0, 130.0], tenants=[], episodes=0,
+    ))
+    assert len(ctx.ops("stroke")) == 1
+    assert len(ctx.ops("lineTo")) == 3  # 4 points: 1 moveTo + 3 lineTo
+    texts = [args[0] for op, args in ctx.ops("fillText")]
+    assert any("130" in t for t in texts)  # last mse labeled
+    # an empty window renders the placeholder, never throws
+    ctx.calls.clear()
+    h.ws.server_message(frame(
+        jsonClass="ModelHealth", level="ok", driftScore=0.0, lossTrend=0.0,
+        weightNorm=1.0, updateNorm=1.0, gradNorm=1.0, mse=[], tenants=[],
+        episodes=0,
+    ))
+    assert len(ctx.ops("stroke")) == 0
+    texts = [args[0] for op, args in ctx.ops("fillText")]
+    assert any("waiting" in t for t in texts)
+
+
+def test_model_health_empty_view_is_placeholder():
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="ModelHealth", level="ok", driftScore=0.0, lossTrend=0.0,
+        weightNorm=0.0, updateNorm=0.0, gradNorm=0.0, mse=[], tenants=[],
+        episodes=0,
+    ))
+    assert h.el("modelTenantsPanel").children == []
+
+
 def test_tenants_empty_view_is_placeholder():
     h = dashboard()
     h.ws.server_open()
@@ -281,6 +381,7 @@ def test_metrics_backfill_fetched_on_boot():
     assert "/api/metrics" in urls
     assert "/api/hosts" in urls
     assert "/api/tenants" in urls
+    assert "/api/model" in urls
 
 
 def test_unknown_jsonclass_is_ignored():

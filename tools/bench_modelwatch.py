@@ -1,0 +1,133 @@
+"""Model-observability overhead check (ISSUE 8): the full --modelWatch
+plane — the in-step quality vector riding the StepOutput fetch PLUS the
+host-side drift/trend watcher fed per batch — measured against a
+quality-off control in the per-batch-telemetry regime (the regime where
+per-batch overheads bind; BENCHMARKS.md).
+
+Arms (interleaved single passes + paired per-round ratios, the house
+method — tools/pairedbench.py):
+
+- off   : the ``--modelWatch off`` program (no quality leaf — the HEAD
+          step program) with no watcher;
+- watch : the quality-leaf program + one modelwatch.record_tick per batch
+          (drift windows, EWMAs, registry gauges — the full delivered-tick
+          cost).
+
+Passes the acceptance gate when the paired ratio (off/watch) is >= 0.97x
+(the ISSUE's <= 3% budget).
+
+Usage: python tools/bench_modelwatch.py [--tweets N] [--batch B]
+          [--budget S]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    n_tweets, batch, budget = 65536, 2048, 120.0
+    i = 0
+    while i < len(args):
+        if args[i] == "--tweets":
+            n_tweets = int(args[i + 1]); i += 2
+        elif args[i] == "--batch":
+            batch = int(args[i + 1]); i += 2
+        elif args[i] == "--budget":
+            budget = float(args[i + 1]); i += 2
+        else:
+            raise SystemExit(f"unknown flag {args[i]!r}")
+
+    import numpy as np
+
+    import jax
+
+    from twtml_tpu.apps.common import FetchPipeline
+    from twtml_tpu.features.batch import pack_batch
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.models import StreamingLinearRegressionWithSGD
+    from twtml_tpu.streaming.sources import SyntheticSource
+    from twtml_tpu.telemetry import modelwatch as _modelwatch
+
+    feat = Featurizer(now_ms=1785320000000)
+    statuses = list(SyntheticSource(total=n_tweets, seed=3).produce())
+    chunks = [statuses[i : i + batch] for i in range(0, len(statuses), batch)]
+    r_batches = [
+        feat.featurize_batch_ragged(c, row_bucket=batch, pre_filtered=True)
+        for c in chunks
+    ]
+
+    def consume_off(out, b, t, at_boundary=True):
+        float(out.count); float(out.mse)
+        float(out.real_stdev); float(out.pred_stdev)
+        _ = out.predictions[0]
+
+    def consume_watch(out, b, t, at_boundary=True):
+        consume_off(out, b, t, at_boundary)
+        _modelwatch.record_tick(
+            np.asarray(out.quality, np.float64),
+            np.asarray(out.count, np.float64),
+            np.asarray(out.mse, np.float64),
+        )
+
+    model_off = StreamingLinearRegressionWithSGD()
+    model_on = StreamingLinearRegressionWithSGD(quality=True)
+    seen = set()
+    for rb in r_batches:  # warm every packed layout BOTH arms dispatch
+        key = (rb.units.shape, str(rb.units.dtype), rb.row_len)
+        if key not in seen:
+            seen.add(key)
+            float(model_off.step(pack_batch(rb)).mse)
+            float(model_on.step(pack_batch(rb)).mse)
+
+    def run_pass(model, consume):
+        model.reset()
+        t0 = time.perf_counter()
+        pipe = FetchPipeline(model, consume, depth=8, pack=True)
+        for b in r_batches:
+            pipe.on_batch(b, 0.0)
+        pipe.flush()
+        return time.perf_counter() - t0
+
+    def off_pass():
+        return run_pass(model_off, consume_off)
+
+    def watch_pass():
+        _modelwatch.reset_for_tests()  # fresh windows per pass
+        return run_pass(model_on, consume_watch)
+
+    off_pass(); watch_pass()  # warm both arms' code paths
+
+    from tools.pairedbench import (
+        best_median_rate, paired_ratio_median, run_rounds,
+    )
+
+    times = run_rounds({"off": off_pass, "watch": watch_pass}, budget)
+    out = {
+        "regime": "modelwatch-overhead", "batch": batch,
+        "tweets": n_tweets, "backend": jax.default_backend(),
+        "rounds": len(times["off"]),
+    }
+    for name, ts in times.items():
+        best, median = best_median_rate(ts, n_tweets)
+        out[name] = {
+            "tweets_per_sec_best": best,
+            "tweets_per_sec_median": median,
+        }
+    out["watch"]["paired_vs_off"] = paired_ratio_median(
+        times["off"], times["watch"]
+    )
+    out["neutral"] = out["watch"]["paired_vs_off"] >= 0.97
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

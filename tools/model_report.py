@@ -1,0 +1,129 @@
+"""Render the model-quality history stamped into verified checkpoints
+(ISSUE 8): every cadence/final/forced save records the model watcher's
+snapshot (level, drift z, loss trend, norms, last mse) in the checkpoint
+meta (apps/common.AppCheckpoint._save), so a checkpoint directory carries
+the promotion-gate substrate the future serving plane reads — "is THIS
+snapshot healthy enough to serve?" — without replaying anything.
+
+Exit status is a CHECK, exactly like tools/postmortem_report.py: 0 = a
+readable checkpoint directory whose archives parse; 2 = malformed (missing
+directory, no checkpoints, or an archive whose meta is unreadable).
+Checkpoints saved before the quality stamp existed render as "(unstamped)"
+and do not fail the check. ``--json`` emits the history as one
+machine-readable line.
+
+Usage: python tools/model_report.py CHECKPOINT_DIR [--json]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+import numpy as np
+
+_CKPT_RE = re.compile(r"^(quarantine-)?ckpt-(\d+)\.npz$")
+
+
+class MalformedHistory(ValueError):
+    pass
+
+
+def load_history(directory: str) -> list[dict]:
+    """Per-checkpoint meta rows (oldest first), quarantined archives
+    included and flagged — a post-mortem wants to see the diverged save's
+    stamp too."""
+    if not os.path.isdir(directory):
+        raise MalformedHistory(f"not a checkpoint directory: {directory!r}")
+    names = sorted(
+        n for n in os.listdir(directory) if _CKPT_RE.match(n)
+    )
+    if not names:
+        raise MalformedHistory(f"no checkpoint archives in {directory!r}")
+    rows = []
+    for name in names:
+        path = os.path.join(directory, name)
+        try:
+            with np.load(path) as data:
+                meta = json.loads(bytes(data["__meta__"]).decode("utf-8"))
+        except Exception as exc:
+            raise MalformedHistory(
+                f"unreadable checkpoint meta in {name}: {exc}"
+            ) from exc
+        if not isinstance(meta, dict) or "step" not in meta:
+            raise MalformedHistory(f"checkpoint {name} meta has no step")
+        rows.append({
+            "name": name,
+            "quarantined": bool(_CKPT_RE.match(name).group(1)),
+            "step": int(meta["step"]),
+            "count": int(meta.get("count", 0)),
+            "finite": bool(meta.get("finite", True)),
+            "quality": meta.get("quality"),
+        })
+    return rows
+
+
+def render(rows: list[dict]) -> str:
+    out = [
+        "checkpoint quality history "
+        f"({len(rows)} archive{'s' if len(rows) != 1 else ''}):",
+        f"  {'step':>10}  {'rows':>10}  {'health':<7} "
+        f"{'drift z':>8}  {'trend':>7}  {'w-norm':>10}  {'mse':>10}",
+    ]
+    for r in rows:
+        q = r["quality"]
+        flag = " QUARANTINED" if r["quarantined"] else ""
+        if not q:
+            out.append(
+                f"  {r['step']:>10}  {r['count']:>10}  (unstamped)" + flag
+            )
+            continue
+        trend = float(q.get("loss_trend", 0.0))
+        out.append(
+            f"  {r['step']:>10}  {r['count']:>10}  {q.get('level', '?'):<7} "
+            f"{float(q.get('drift_score', 0.0)):>8.2f}  "
+            f"{trend * 100:>+6.1f}%  "
+            f"{float(q.get('weight_norm', 0.0)):>10.2f}  "
+            f"{float(q.get('mse', -1.0)):>10.2f}" + flag
+        )
+    stamped = [r for r in rows if r["quality"]]
+    if stamped:
+        last = stamped[-1]["quality"]
+        out.append(
+            f"  latest stamped: step {stamped[-1]['step']} — "
+            f"{last.get('level', '?')} (drift z "
+            f"{float(last.get('drift_score', 0.0)):.2f}, "
+            f"{int(last.get('episodes', 0))} drift episodes over "
+            f"{int(last.get('ticks', 0))} ticks)"
+        )
+    else:
+        out.append("  (no quality stamps — run with --modelWatch on)")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in args
+    args = [a for a in args if a != "--json"]
+    if len(args) != 1:
+        print(__doc__, file=sys.stderr)
+        return 2
+    try:
+        rows = load_history(args[0])
+    except (OSError, MalformedHistory) as exc:
+        print(f"model_report: malformed history: {exc}", file=sys.stderr)
+        return 2
+    if as_json:
+        print(json.dumps(rows))
+    else:
+        print(render(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    raise SystemExit(main())

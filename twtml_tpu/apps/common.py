@@ -17,6 +17,7 @@ from ..streaming import faults as _faults
 from ..streaming.sources import ReplayFileSource, Source, SyntheticSource
 from ..telemetry import blackbox as _blackbox
 from ..telemetry import metrics as _metrics
+from ..telemetry import modelwatch as _modelwatch
 from ..telemetry import sideband as _sideband
 from ..telemetry import trace as _trace
 from ..utils import get_logger
@@ -510,10 +511,14 @@ class AppCheckpoint:
         if not self._lead:
             self._last = totals["batches"]  # keep cadence bookkeeping aligned
             return
-        self._ckpt.save(
-            totals["batches"], self._get_state(),
-            {"count": totals["count"], "batches": totals["batches"]},
-        )
+        meta = {"count": totals["count"], "batches": totals["batches"]}
+        # quality stamp (ISSUE 8): every verified checkpoint records the
+        # model-health picture at save time — the promotion-gate substrate
+        # the serving plane reads (tools/model_report.py renders history)
+        quality = _modelwatch.snapshot_for_checkpoint()
+        if quality is not None:
+            meta["quality"] = quality
+        self._ckpt.save(totals["batches"], self._get_state(), meta)
         self._last = totals["batches"]
         # sticky flight-recorder context: a post-mortem bundle names the
         # checkpoint a restart will resume from (telemetry/blackbox.py)
@@ -769,6 +774,81 @@ class DivergenceSentinel:
                 len(in_window), self.window,
             )
             self._ssc.request_abort()
+
+
+class ModelWatchGuard:
+    """``--modelWatch`` delivery adapter (ISSUE 8): feeds the host-side
+    model watcher (telemetry/modelwatch.py) from the quality leaf the
+    pipeline ALREADY fetched inside the StepOutput — zero added host
+    fetches, zero added collectives, exactly like the sentinel's
+    finiteness check — and implements the sentinel EARLY-WARNING hook:
+    when the watcher holds ``alert`` for ``--modelWatchWindow`` delivered
+    batches, it emits a blackbox event + counter and forces ONE immediate
+    verified-checkpoint save per episode (warn-only: the sentinel's
+    non-finite rollback machine is untouched — an alerting-but-finite
+    model keeps training, it just leaves a restorable snapshot + evidence
+    behind before things possibly get worse).
+
+    Multi-host: the quality vector is psum-global, so every host derives
+    the same verdict on the same delivered batch; the forced save is
+    lead-only inside ``AppCheckpoint`` like every other save."""
+
+    def __init__(self, conf, ckpt: "AppCheckpoint | None", totals: dict,
+                 lead: bool = True):
+        self.enabled = getattr(conf, "modelWatch", "on") == "on"
+        self.window = max(1, int(getattr(conf, "modelWatchWindow", 8) or 1))
+        self._ckpt = ckpt
+        self._totals = totals
+        self._lead = lead
+        self._saved_episode = False
+        self._alert_saves = _metrics.get_registry().counter(
+            "model.alert_checkpoints"
+        )
+
+    def observe(self, out, at_boundary: bool = True) -> None:
+        """Per-delivery hook (wired OUTSIDE the tenant adapter in
+        ``attach_super_batcher``, so the tenant plane's raw [M, Q] quality
+        leaf is visible here — per-tenant drift for free)."""
+        if not self.enabled or getattr(out, "quality", None) is None:
+            return
+        import numpy as np
+
+        counts = np.atleast_1d(np.asarray(out.count, np.float64))
+        if float(counts.sum()) <= 0:
+            return  # an all-padding / globally-empty tick carries no data
+        verdict = _modelwatch.record_tick(
+            np.asarray(out.quality, np.float64), counts,
+            np.asarray(out.mse, np.float64),
+        )
+        if verdict["level"] != "alert":
+            self._saved_episode = False
+            return
+        if (
+            verdict["alert_run"] >= self.window
+            and not self._saved_episode
+            and at_boundary  # save_now reads weights — they must be current
+        ):
+            self._saved_episode = True
+            self._alert_saves.inc()
+            _blackbox.record(
+                "modelwatch_alert_checkpoint",
+                batches=self._totals.get("batches", 0),
+                drift=round(verdict["drift_score"], 3),
+                trend=round(verdict["loss_trend"], 4),
+            )
+            saved = self._ckpt.save_now(self._totals) if (
+                self._ckpt is not None
+            ) else False
+            log.warning(
+                "model watch: ALERT held for %d batches (drift z=%.2f, "
+                "loss trend %+.1f%%) — %s (early warning only; training "
+                "continues, the sentinel still owns rollback)",
+                verdict["alert_run"], verdict["drift_score"],
+                verdict["loss_trend"] * 100.0,
+                "forced a verified-checkpoint save"
+                if saved else "no checkpoint dir configured, evidence "
+                "recorded to the flight recorder only",
+            )
 
 
 class ProcessRecycler:
@@ -1580,7 +1660,8 @@ class FetchPipeline:
 
 
 def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
-                         max_dispatch: int = 0, abort=None, sentinel=None):
+                         max_dispatch: int = 0, abort=None, sentinel=None,
+                         modelwatch=None):
     """Wire the app's per-batch ``handle(out, batch, t, at_boundary)`` to the
     stream: plain step-then-handle by default, grouped through a
     SuperBatcher when ``--superBatch K`` applies. Returns
@@ -1659,6 +1740,17 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
                 aggregate_tenant_output(out, batch, model), batch, t,
                 at_boundary=at_boundary,
             )
+
+    if modelwatch is not None and modelwatch.enabled:
+        # model-watch adapter (ISSUE 8), wrapped OUTSIDE the tenant
+        # aggregation so it reads the RAW StepOutput — the tenant plane's
+        # stacked [M, Q] quality leaf gives per-tenant drift for free;
+        # pure host bookkeeping on arrays the fetch already delivered
+        mw_inner = handle
+
+        def handle(out, batch, t, at_boundary=True):  # noqa: F811
+            modelwatch.observe(out, at_boundary=at_boundary)
+            mw_inner(out, batch, t, at_boundary=at_boundary)
 
     multihost = jax.process_count() > 1
     k = int(getattr(conf, "superBatch", 1) or 1)

@@ -104,9 +104,14 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     # divergence sentinel (--sentinel, default on): non-finite per-batch
     # stats → skip the batch, roll back to the last verified-finite
     # checkpoint, abort cleanly after N rollbacks (apps/common)
-    from .common import DivergenceSentinel
+    from .common import DivergenceSentinel, ModelWatchGuard
 
     sentinel = DivergenceSentinel(conf, model, ckpt, ssc, lead=lead)
+
+    # model watch (--modelWatch, default on): drift/loss-trend telemetry
+    # from the in-step quality vector riding the existing stats fetch;
+    # sustained alert forces a verified-checkpoint save (apps/common)
+    modelwatch = ModelWatchGuard(conf, ckpt, totals, lead=lead)
 
     from ..utils.tracing import Tracer
 
@@ -149,6 +154,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         ),
         abort=ssc.request_abort,  # fetch-watchdog aborts fail the run loudly
         sentinel=sentinel,
+        modelwatch=modelwatch,
     )
 
     warmup_compile(stream, model, super_batch=group_k)

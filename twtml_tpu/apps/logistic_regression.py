@@ -97,6 +97,11 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     # divergence sentinel — same guard as the flagship app (apps/common)
     sentinel = DivergenceSentinel(conf, model, ckpt, ssc, lead=lead)
 
+    # model watch — same drift/trend plane as the flagship app
+    from .common import ModelWatchGuard
+
+    modelwatch = ModelWatchGuard(conf, ckpt, totals, lead=lead)
+
     def handle(out, batch, _batch_time, at_boundary=True) -> None:
         b = int(out.count)
         totals["count"] += b
@@ -138,6 +143,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         ),
         abort=ssc.request_abort,  # fetch-watchdog aborts fail the run loudly
         sentinel=sentinel,
+        modelwatch=modelwatch,
     )
     warmup_compile(stream, model, super_batch=group_k)
     ssc.start(lockstep=lockstep)

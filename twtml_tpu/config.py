@@ -185,6 +185,13 @@ class ConfArguments:
             )
         self.sentinelRollbacks: int = int(conf.get("sentinelRollbacks", "3"))
         self.sentinelWindow: int = int(conf.get("sentinelWindow", "512"))
+        # model & data observability plane (r11): in-step quality telemetry
+        self.modelWatch: str = conf.get("modelWatch", "on")
+        if self.modelWatch not in ("on", "off"):
+            raise ValueError(
+                f"modelWatch must be 'on' or 'off', got {self.modelWatch!r}"
+            )
+        self.modelWatchWindow: int = int(conf.get("modelWatchWindow", "8"))
 
         # Multi-host process group (the reference's one-flag cluster story,
         # ConfArguments.scala:95-98 --master spark://host:port): here a
@@ -357,6 +364,25 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                                                Default: {self.sentinelRollbacks}
   --sentinelWindow <int batches>               The rollback-rate window above.
                                                Default: {self.sentinelWindow}
+  --modelWatch <on|off>                        Model & data observability plane: a small
+                                               quality vector (weight/update/gradient norms,
+                                               prediction/label/residual and dense-feature
+                                               moments, hash-bucket occupancy) computed INSIDE
+                                               the fused step and fetched with the stats it
+                                               already ships (zero extra fetches); the host
+                                               derives drift z-scores, a loss-trend slope, and
+                                               ok/warn/alert health levels (/api/model +
+                                               dashboard "model · drift" tiles; verified
+                                               checkpoints are stamped with the quality
+                                               snapshot — tools/model_report.py). 'off' makes
+                                               the step program bit-identical to the
+                                               pre-observability program. Default: {self.modelWatch}
+  --modelWatchWindow <int batches>             Sentinel early warning: after the model watch
+                                               holds 'alert' this many delivered batches, emit
+                                               a blackbox event + counter and force ONE
+                                               verified-checkpoint save per episode (warn-only;
+                                               no rollback behavior change).
+                                               Default: {self.modelWatchWindow}
   --blockWire <auto|on|off>                    Zero-copy native ingest for --ingest block:
                                                'on' parses raw block bytes straight into the
                                                ragged wire's unit representation (one C pass,
@@ -501,6 +527,12 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
             self.sentinelRollbacks = int(take())
         elif flag == "--sentinelWindow":
             self.sentinelWindow = int(take())
+        elif flag == "--modelWatch":
+            self.modelWatch = take()
+            if self.modelWatch not in ("on", "off"):
+                self.printUsage(1)
+        elif flag == "--modelWatchWindow":
+            self.modelWatchWindow = int(take())
         elif flag == "--faultEvery":
             self.faultEvery = int(take())
         elif flag == "--chaos":

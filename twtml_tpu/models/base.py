@@ -11,7 +11,7 @@ on device, and the SGD iterations run inside the same XLA program.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax.numpy as jnp
 
@@ -20,10 +20,19 @@ class StepOutput(NamedTuple):
     """Device results of one micro-batch step. ``predictions`` keeps the full
     padded [B] vector (with ``mask`` deciding validity) so telemetry can ship
     the real-vs-pred series like the reference does to Lightning
-    (SessionStats.scala:31-33); the scalars are the dashboard stats."""
+    (SessionStats.scala:31-33); the scalars are the dashboard stats.
+
+    ``quality`` (ISSUE 8, ``--modelWatch``) is the in-step model/data
+    quality vector (ops/quality.QUALITY_FIELDS) — [Q] per batch, [M, Q]
+    stacked on the tenant plane, [K, Q] under a superbatch scan. It is a
+    telemetry side channel riding the existing one-fetch-per-tick
+    StepOutput transfer; ``None`` (an empty pytree — the default, and the
+    ``--modelWatch off`` state) keeps the step program structurally
+    identical to the pre-quality program."""
 
     predictions: jnp.ndarray  # [B] rounded predictions (pre-update weights)
     count: jnp.ndarray  # scalar — valid rows in this batch (global if psum)
     mse: jnp.ndarray  # scalar — mean((y - round(ŷ))²) over valid rows
     real_stdev: jnp.ndarray  # scalar — population stdev of labels
     pred_stdev: jnp.ndarray  # scalar — population stdev of rounded preds
+    quality: Optional[jnp.ndarray] = None  # [QUALITY_WIDTH] side channel

@@ -51,6 +51,7 @@ from ..ops.gram import (
     gram_matrix,
     text_gram,
 )
+from ..ops.quality import quality_vector
 from ..ops.ragged import ragged_repad
 from ..ops.sparse import densify_text, sparse_grad_text, sparse_predict
 from ..ops.stats import batch_stats
@@ -241,6 +242,7 @@ def make_sgd_train_step(
     round_predictions: bool = True,
     use_gram: bool | None = None,
     gram_int8: bool | None = None,
+    quality: bool = False,
 ):
     """Build the fused (weights, batch) → (new_weights, StepOutput) step.
 
@@ -272,6 +274,15 @@ def make_sgd_train_step(
     (None = the module default, ops/gram.py ``GRAM_INT8_PLANE``) — threaded
     as a parameter, not a global read, so multi-shape callers (the ragged
     wire retraces per flat-buffer bucket) get ONE consistent plane.
+
+    ``quality`` (ISSUE 8) appends the in-step quality vector
+    (ops/quality.py) as ``StepOutput.quality`` — weight/update/gradient
+    norms and data moments computed inside this same XLA program, riding
+    the existing one-fetch StepOutput. Observation-only: weights,
+    predictions, and the five reference stats are bit-identical with it on
+    or off, and ``False`` (the default / ``--modelWatch off``) leaves the
+    output pytree — hence the compiled program — structurally the
+    pre-quality program (the leaf is None).
     """
     f_text = num_text_features
     sparse = f_text > DENSE_TEXT_FEATURE_LIMIT if use_sparse is None else use_sparse
@@ -419,6 +430,19 @@ def make_sgd_train_step(
             preds = jnp_round_half_up(preds)
         stats = batch_stats(labels, preds, mask, axis_name)
 
+        def _quality(w_new):
+            # the ISSUE-8 side channel against the post-update weights;
+            # None (plane off) keeps the output pytree the HEAD program's
+            if not quality:
+                return None
+            return quality_vector(
+                weights, w_new,
+                residual=residual_fn(raw, labels) * mask,
+                preds=preds, labels=labels, mask=mask,
+                numeric=batch.numeric, token_idx=batch.token_idx,
+                token_val=batch.token_val, axis_name=axis_name,
+            )
+
         # ---- numIterations of mini-batch SGD ----------------------------
         b_global = batch.mask.shape[0] * (_axis_size(axis_name) if axis_name else 1)
         gram = (
@@ -440,8 +464,9 @@ def make_sgd_train_step(
                     lax.all_gather(a, axis_name, axis=0, tiled=True)
                     for a in row_args
                 )
-            return _gram_sgd(weights, row_args, local_args), StepOutput(
-                predictions=preds, **stats
+            w_new = _gram_sgd(weights, row_args, local_args)
+            return w_new, StepOutput(
+                predictions=preds, quality=_quality(w_new), **stats
             )
 
         def grad_and_count(w, sel):
@@ -464,7 +489,9 @@ def make_sgd_train_step(
             sample_key=sampling_key(axis_name, mini_batch_fraction),
             grad_and_count=grad_and_count,
         )
-        return w_final, StepOutput(predictions=preds, **stats)
+        return w_final, StepOutput(
+            predictions=preds, quality=_quality(w_final), **stats
+        )
 
     return train_step
 
@@ -502,6 +529,7 @@ class StreamingSGDModel:
         use_sparse: bool | None = None,
         use_gram: bool | None = None,
         gram_int8: bool | None = None,
+        quality: bool = False,
     ) -> None:
         self.num_text_features = num_text_features
         self.dtype = dtype
@@ -519,6 +547,7 @@ class StreamingSGDModel:
             use_sparse=use_sparse,
             use_gram=use_gram,  # None=auto; False is the scatter-loop escape hatch
             gram_int8=gram_int8,
+            quality=quality,  # --modelWatch: the in-step quality side channel
         )
         # donate weights: the update happens in-place in HBM
         self._train_step = step
@@ -535,6 +564,7 @@ class StreamingSGDModel:
             l2_reg=conf.l2Reg,
             convergence_tol=conf.convergenceTol,
             dtype=jnp.dtype(conf.dtype),
+            quality=getattr(conf, "modelWatch", "off") == "on",
         )
         kwargs.update(overrides)
         return cls(**kwargs)

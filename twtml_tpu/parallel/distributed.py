@@ -300,8 +300,11 @@ class MultiHostSGDModel:
         the row axis is axis 1 of a stacked output)."""
         from ..models.base import StepOutput
 
-        count, mse, real_stdev, pred_stdev = jax.device_get(
-            (outs.count, outs.mse, outs.real_stdev, outs.pred_stdev)
+        # the quality leaf (None when --modelWatch off — an empty pytree)
+        # rides the same ONE pooled transfer as the scalar stats
+        count, mse, real_stdev, pred_stdev, quality = jax.device_get(
+            (outs.count, outs.mse, outs.real_stdev, outs.pred_stdev,
+             outs.quality)
         )
         preds = None
         if self._lead:
@@ -320,6 +323,7 @@ class MultiHostSGDModel:
             mse=mse,
             real_stdev=real_stdev,
             pred_stdev=pred_stdev,
+            quality=quality,
         )
 
     def fetch_output(self, out):
@@ -331,8 +335,8 @@ class MultiHostSGDModel:
         each is a full transport round trip, BENCHMARKS.md)."""
         from ..models.base import StepOutput
 
-        count, mse, real_stdev, pred_stdev = jax.device_get(
-            (out.count, out.mse, out.real_stdev, out.pred_stdev)
+        count, mse, real_stdev, pred_stdev, quality = jax.device_get(
+            (out.count, out.mse, out.real_stdev, out.pred_stdev, out.quality)
         )
         return StepOutput(
             predictions=(
@@ -342,6 +346,7 @@ class MultiHostSGDModel:
             mse=mse,
             real_stdev=real_stdev,
             pred_stdev=pred_stdev,
+            quality=quality,
         )
 
 

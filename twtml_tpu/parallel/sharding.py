@@ -334,6 +334,7 @@ class ParallelSGDModel:
         use_sparse: bool | None = None,
         use_gram: bool | None = None,
         gram_int8: bool | None = None,
+        quality: bool = False,
     ) -> None:
         self.mesh = mesh
         self.num_text_features = num_text_features
@@ -344,6 +345,19 @@ class ParallelSGDModel:
         self.num_data = mesh.shape[self.data_axis]
         out_pred_spec = P(self.data_axis)
         scalar = P()
+        if quality and self.model_axis is not None:
+            # the feature-sharded (2D) step has its own body below; its
+            # weight norms would need model-axis psums the quality plane
+            # doesn't wire yet — degrade loudly rather than mis-report
+            from ..utils import get_logger
+
+            get_logger("parallel.sharding").warning(
+                "--modelWatch quality vector is not wired for the "
+                "feature-sharded (2D model-axis) layout; disabling the "
+                "in-step quality leaf for this model"
+            )
+            quality = False
+        self.quality = quality
 
         if self.model_axis is None:
             step = make_sgd_train_step(
@@ -360,6 +374,7 @@ class ParallelSGDModel:
                 use_sparse=use_sparse,
                 use_gram=use_gram,
                 gram_int8=gram_int8,
+                quality=quality,
             )
             self._weights = jnp.zeros(
                 (num_text_features + NUM_NUMBER_FEATURES,), dtype
@@ -410,6 +425,10 @@ class ParallelSGDModel:
                 mse=scalar,
                 real_stdev=scalar,
                 pred_stdev=scalar,
+                # the quality vector is psum-global (axis-invariant), hence
+                # replicated like the scalar stats; None when the plane is
+                # off keeps the spec tree structurally the HEAD tree
+                quality=scalar if quality else None,
             ),
         )
         # compiled programs: keyed by batch class, plus (cls, 'scan')
@@ -491,6 +510,7 @@ class ParallelSGDModel:
             l2_reg=conf.l2Reg,
             convergence_tol=conf.convergenceTol,
             dtype=jnp.dtype(conf.dtype),
+            quality=getattr(conf, "modelWatch", "off") == "on",
         )
         kwargs.update(overrides)
         return cls(mesh, **kwargs)

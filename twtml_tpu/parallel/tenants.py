@@ -79,7 +79,13 @@ def aggregate_tenant_output(out, batch, model) -> StepOutput:
     re-order to original rows via the deterministic routing key — the same
     route the wire used, recomputed instead of carried through the fetch
     pipeline. A non-finite stat in ANY tenant propagates into the
-    aggregate, so the divergence sentinel still sees every poisoning."""
+    aggregate, so the divergence sentinel still sees every poisoning.
+
+    ``quality`` (ISSUE 8): M = 1 passes tenant 0's vector through like
+    every other leaf; M > 1 leaves the aggregate's quality None — norms of
+    M independent models don't pool into one meaningful vector, and the
+    model-watch adapter consumes the per-tenant [M, Q] leaf BEFORE this
+    aggregation (apps/common.attach_super_batcher wrapping order)."""
     from ..features.batch import tenant_rows
 
     m = model.num_tenants
@@ -166,6 +172,7 @@ class TenantStackModel:
         step_sizes=None,
         l2_regs=None,
         mapping: str = "scan",
+        quality: bool = False,
     ) -> None:
         if num_tenants < 1:
             raise ValueError(f"num_tenants must be >= 1, got {num_tenants}")
@@ -182,6 +189,10 @@ class TenantStackModel:
         self.wire_pack = wire_pack
         self.mapping = mapping
         self.mesh = mesh
+        # --modelWatch: the mapped step computes each tenant's quality
+        # vector inside the one jit program — the stacked [M, Q] leaf rides
+        # the existing ONE stacked fetch, so per-tenant quality is free
+        self.quality = quality
         f_total = num_text_features + NUM_NUMBER_FEATURES
 
         # per-tenant hyperparams as MAPPED scalar leaves: they are consumed
@@ -223,6 +234,7 @@ class TenantStackModel:
                 use_sparse=use_sparse,
                 use_gram=use_gram,
                 gram_int8=gram_int8,
+                quality=quality,
             )
             return step(weights, batch)
 
@@ -270,12 +282,16 @@ class TenantStackModel:
                 predictions=P(t_axis, self._data_axis),
                 count=P(t_axis), mse=P(t_axis),
                 real_stdev=P(t_axis), pred_stdev=P(t_axis),
+                # [M, Q]: tenant axis sharded like the other stacked leaves
+                quality=P(t_axis) if self.quality else None,
             )
         else:
             self._w_spec, self._h_spec = P(), P()
             self._out_specs = StepOutput(
                 predictions=P(None, self._data_axis),
                 count=P(), mse=P(), real_stdev=P(), pred_stdev=P(),
+                # [M, Q] psum-global over data, replicated like the scalars
+                quality=P() if self.quality else None,
             )
 
     def _batch_spec(self, batch_cls):
@@ -524,6 +540,7 @@ class TenantStackModel:
                 else "stacked"
             ),
             mesh=mesh,
+            quality=getattr(conf, "modelWatch", "off") == "on",
         )
         kwargs.update(overrides)
         return cls(**kwargs)

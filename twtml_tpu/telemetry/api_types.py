@@ -105,8 +105,34 @@ class Tenants:
     json_class = "Tenants"
 
 
+@dataclass
+class ModelHealth:
+    """Model & data quality view — an ADDITIVE message type (no reference
+    equivalent; the reference has no model-health signal at all). Derived
+    by telemetry/modelwatch.py from the in-step quality vector the
+    pipeline already fetched (zero added fetches, the PR 1/5 law):
+    graduated health level (ok/warn/alert), the max drift z-score and
+    loss-trend slope, the weight/update/gradient norms, a rolling mse
+    window (the dashboard's loss sparkline), and per-tenant rows on the
+    multi-tenant plane. Legacy dashboards ignore it like
+    Series/Metrics/Hosts/Tenants."""
+
+    level: str = "ok"
+    driftScore: float = 0.0
+    lossTrend: float = 0.0
+    weightNorm: float = 0.0
+    updateNorm: float = 0.0
+    gradNorm: float = 0.0
+    mse: list = field(default_factory=list)
+    tenants: list = field(default_factory=list)
+    episodes: int = 0
+
+    json_class = "ModelHealth"
+
+
 TYPES = {"Config": Config, "Stats": Stats, "Series": Series,
-         "Metrics": Metrics, "Hosts": Hosts, "Tenants": Tenants}
+         "Metrics": Metrics, "Hosts": Hosts, "Tenants": Tenants,
+         "ModelHealth": ModelHealth}
 
 
 def encode(obj: Config | Stats) -> str:

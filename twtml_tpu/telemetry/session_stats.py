@@ -43,6 +43,13 @@ METRICS_EVERY = 8
 # while the health monitor reports a degraded transport
 SERIES_SHED_EVERY = 8
 
+# host-process gauges (ISSUE 8 satellite): uptime is measured from this
+# module's import — the app imports it at startup, so the gauge tracks the
+# process lifetime the axon-client RSS retention grows over
+import time as _time_mod
+
+_PROCESS_START_S = _time_mod.monotonic()
+
 # SessionStats.scala:15-20
 REAL_COLOR_DET = [173.0, 216.0, 230.0]  # light blue
 REAL_COLOR = [30.0, 144.0, 255.0]  # blue
@@ -183,6 +190,20 @@ class SessionStats:
         summary to the dashboard's observability panel (/api/metrics) —
         with derived per-histogram p50/p95/p99 (the latency tile), and the
         per-host ``Hosts`` view when a lockstep sideband is live."""
+        # host-process gauges, sampled per publish tick (ISSUE 8 satellite):
+        # makes the known axon-client RSS growth (BENCHMARKS r3 soak)
+        # visible on every /api/metrics payload and post-mortem bundle —
+        # statm reads, no device traffic
+        try:
+            from ..utils.rss import rss_mb
+
+            reg = _metrics.get_registry()
+            reg.gauge("host.rss_mb").set(round(rss_mb(), 1))
+            reg.gauge("host.uptime_s").set(
+                round(_time_mod.monotonic() - _PROCESS_START_S, 1)
+            )
+        except Exception:
+            pass
         if not self._web_breaker.allow():
             return
         try:
@@ -231,3 +252,26 @@ class SessionStats:
             except Exception:
                 self._web_breaker.record_failure()
                 log.debug("web.tenants failed", exc_info=True)
+        # model-health view (telemetry/modelwatch.py — derived from the
+        # in-step quality vector the pipeline already fetched; empty until
+        # a --modelWatch tick has been recorded)
+        from . import modelwatch as _modelwatch
+
+        mview = _modelwatch.last_model()
+        if mview is not None and self._web_breaker.allow():
+            try:
+                self.web.model_health(
+                    level=mview["level"],
+                    drift_score=mview["drift_score"],
+                    loss_trend=mview["loss_trend"],
+                    weight_norm=mview["weight_norm"],
+                    update_norm=mview["update_norm"],
+                    grad_norm=mview["grad_norm"],
+                    mse=mview["mse"],
+                    tenants=mview["tenants"],
+                    episodes=mview["episodes"],
+                )
+                self._web_breaker.record_success()
+            except Exception:
+                self._web_breaker.record_failure()
+                log.debug("web.model_health failed", exc_info=True)

@@ -12,7 +12,8 @@ from __future__ import annotations
 import urllib.request
 
 from .api_types import (
-    Config, Hosts, Metrics, Series, Stats, Tenants, decode, encode,
+    Config, Hosts, Metrics, ModelHealth, Series, Stats, Tenants, decode,
+    encode,
 )
 
 DEFAULT_SERVER = "http://localhost:8888"  # WebClient.scala:13
@@ -95,6 +96,21 @@ class WebClient:
         tile row (additive message; telemetry/tenants.py)."""
         self._post(Tenants(tenants=list(tenants), gating=int(gating),
                            active=int(active)))
+
+    def model_health(self, level: str = "ok", drift_score: float = 0.0,
+                     loss_trend: float = 0.0, weight_norm: float = 0.0,
+                     update_norm: float = 0.0, grad_norm: float = 0.0,
+                     mse=None, tenants=None, episodes: int = 0) -> None:
+        """Push the model-health view for the dashboard's "model · drift"
+        tile row + loss sparkline (additive message;
+        telemetry/modelwatch.py)."""
+        self._post(ModelHealth(
+            level=str(level), driftScore=float(drift_score),
+            lossTrend=float(loss_trend), weightNorm=float(weight_norm),
+            updateNorm=float(update_norm), gradNorm=float(grad_norm),
+            mse=[float(v) for v in (mse or [])],
+            tenants=list(tenants or []), episodes=int(episodes),
+        ))
 
     # -- reads (WebClient.scala:40-46) ---------------------------------------
     def get_config(self) -> Config:

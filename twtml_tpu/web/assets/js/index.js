@@ -126,6 +126,77 @@
     }
   }
 
+  function drawLossSpark(values) {
+    // rolling per-batch mse sparkline (ModelHealth.mse window)
+    const canvas = document.getElementById("lossSpark");
+    const ctx = canvas.getContext("2d");
+    const w = (canvas.width = canvas.clientWidth || 800);
+    const h = (canvas.height = canvas.clientHeight || 60);
+    ctx.clearRect(0, 0, w, h);
+    if (!values.length) {
+      ctx.fillStyle = "rgba(128,128,128,0.6)";
+      ctx.font = "11px system-ui";
+      ctx.fillText("loss sparkline — waiting for model telemetry…", 8, 14);
+      return;
+    }
+    let lo = Math.min(...values), hi = Math.max(...values);
+    if (hi === lo) { hi = lo + 1; }
+    ctx.beginPath();
+    ctx.strokeStyle = "rgb(29, 78, 216)";
+    ctx.lineWidth = 1.4;
+    values.forEach((v, i) => {
+      const x = (i / Math.max(values.length - 1, 1)) * (w - 10) + 5;
+      const y = h - 6 - ((v - lo) / (hi - lo)) * (h - 12);
+      i ? ctx.lineTo(x, y) : ctx.moveTo(x, y);
+    });
+    ctx.stroke();
+    ctx.fillStyle = "rgba(128,128,128,0.8)";
+    ctx.font = "10px system-ui";
+    ctx.fillText("mse " + Math.round(values[values.length - 1]), 6, 12);
+  }
+
+  function onModelHealth(json) {
+    // model & data quality tiles (telemetry/modelwatch.py): graduated
+    // health badge, drift z / loss-trend numbers, norm gauges, per-tenant
+    // drift tiles on the multi-tenant plane, and the loss sparkline
+    const level = json.level || "—";
+    const badge = document.getElementById("modelLevel");
+    badge.textContent = level;
+    badge.classList.toggle("ok", level === "ok");
+    badge.classList.toggle("warn", level === "warn");
+    badge.classList.toggle("alert", level === "alert");
+    document.getElementById("driftScore").textContent =
+      Number(json.driftScore || 0).toFixed(1);
+    const trend = Number(json.lossTrend || 0);
+    document.getElementById("lossTrend").textContent =
+      (trend >= 0 ? "+" : "") + (trend * 100).toFixed(0) + "%";
+    document.getElementById("weightNorm").textContent =
+      Number(json.weightNorm || 0).toFixed(1);
+    document.getElementById("updateNorm").textContent =
+      Number(json.updateNorm || 0).toFixed(2);
+    document.getElementById("driftEpisodes").textContent =
+      String(json.episodes || 0);
+    const panel = document.getElementById("modelTenantsPanel");
+    panel.replaceChildren();
+    for (const t of json.tenants || []) {
+      const tile = document.createElement("div");
+      tile.className = "stat";
+      const alerting = t.level === "alert" || t.level === "warn";
+      if (alerting) tile.classList.add("alerting");
+      const label = document.createElement("div");
+      label.className = "label";
+      label.textContent = "tenant " + t.tenant;
+      const value = document.createElement("div");
+      value.className = "value";
+      value.textContent =
+        (t.level || "ok") + " · z " + Number(t.drift || 0).toFixed(1);
+      tile.appendChild(label);
+      tile.appendChild(value);
+      panel.appendChild(tile);
+    }
+    drawLossSpark(json.mse || []);
+  }
+
   function onMessage(json) {
     switch (json.jsonClass) {
       case "Config": onConfig(json); break;
@@ -133,6 +204,7 @@
       case "Metrics": onMetrics(json); break;
       case "Hosts": onHosts(json); break;
       case "Tenants": onTenants(json); break;
+      case "ModelHealth": onModelHealth(json); break;
       case "Series":
         // live frames buffer until the history backfill lands (ordering)
         if (!backfilled) pendingSeries.push(json);
@@ -159,6 +231,8 @@
     fetch("/api/hosts").then((r) => r.json()).then(onHosts).catch(() => {});
     // per-tenant model-plane backfill (empty tenants[] single-tenant)
     fetch("/api/tenants").then((r) => r.json()).then(onTenants).catch(() => {});
+    // model-health backfill (level "ok", empty sparkline until telemetry)
+    fetch("/api/model").then((r) => r.json()).then(onModelHealth).catch(() => {});
     // backfill the chart from the server's rolling series window, then
     // apply any live frames that arrived while the fetch was in flight
     const flush = () => {

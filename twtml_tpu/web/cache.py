@@ -17,7 +17,8 @@ import collections
 import json as _json
 
 from ..telemetry.api_types import (
-    Config, Hosts, Metrics, Series, Stats, Tenants, decode, encode,
+    Config, Hosts, Metrics, ModelHealth, Series, Stats, Tenants, decode,
+    encode,
 )
 from ..utils import get_logger
 
@@ -37,6 +38,7 @@ class ApiCache:
         self._metrics = Metrics()
         self._hosts = Hosts()
         self._tenants = Tenants()
+        self._model = ModelHealth()
         self._series: collections.deque[Series] = collections.deque(
             maxlen=SERIES_WINDOW
         )
@@ -58,6 +60,10 @@ class ApiCache:
     def tenants(self) -> str:
         """Latest per-tenant model-plane view (in-memory only)."""
         return encode(self._tenants)
+
+    def model(self) -> str:
+        """Latest model-health view (in-memory only, like Stats)."""
+        return encode(self._model)
 
     def series(self) -> str:
         """Recent Series messages as a JSON array (chart backfill for
@@ -87,6 +93,8 @@ class ApiCache:
             self._hosts = data
         elif isinstance(data, Tenants):
             self._tenants = data
+        elif isinstance(data, ModelHealth):
+            self._model = data
         elif isinstance(data, Series):
             self._series.append(data)
         else:

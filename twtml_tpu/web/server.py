@@ -80,6 +80,10 @@ class Server:
         return web.Response(text=self.cache.tenants(),
                             content_type="application/json")
 
+    async def _get_model(self, request: web.Request) -> web.StreamResponse:
+        return web.Response(text=self.cache.model(),
+                            content_type="application/json")
+
     async def _ws_api(self, request: web.Request) -> web.StreamResponse:
         ws = web.WebSocketResponse(heartbeat=30)
         await ws.prepare(request)
@@ -170,6 +174,7 @@ class Server:
         app.router.add_get("/api/metrics", self._get_metrics)  # observability
         app.router.add_get("/api/hosts", self._get_hosts)  # lockstep fleet view
         app.router.add_get("/api/tenants", self._get_tenants)  # model plane
+        app.router.add_get("/api/model", self._get_model)  # model health
         app.router.add_get("/", self._index)
         app.router.add_get("/{path:.+}", self._static)
         return app
