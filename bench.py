@@ -161,6 +161,30 @@ def main() -> None:
     if child == "device":
         print(json.dumps(measure()))
         return
+    if child == "serving":
+        # compact serving-plane record (ISSUE 9): coalesced + depth-8
+        # pipelined vs naive per-request under the 70 ms modeled-RTT
+        # control — the mechanism number; tools/bench_serving.py is the
+        # full paired harness (run it on the tunnel with --modelRttMs 0)
+        from tools.bench_serving import measure as serving_measure
+
+        rec = serving_measure(
+            requests=64, rows_per_request=16, batch_rows=256, depth=8,
+            budget=25.0, model_rtt_ms=70.0,
+        )
+        print(json.dumps({
+            "qps_pipelined_rtt70": rec["pipelined_rtt"]["qps_median"],
+            "qps_naive_rtt70": rec["naive_rtt"]["qps_median"],
+            "p99_ms_rtt70": rec["pipelined_rtt"]["p99_ms"],
+            "paired_speedup_rtt70": (
+                rec["pipelined_rtt"]["paired_speedup_vs_naive"]
+            ),
+            "paired_speedup_cpu_control": (
+                rec["pipelined"]["paired_speedup_vs_naive"]
+            ),
+            "backend": rec["backend"],
+        }))
+        return
 
     # device measurement with a watchdog (TWTML_BENCH_TIMEOUT seconds):
     # a dead TPU tunnel yields a CPU-fallback record instead of a hang and
@@ -171,6 +195,14 @@ def main() -> None:
     device_result, device_err = _run_child("device", timeout)
     cpu_result, cpu_err = _run_child("cpu", timeout)
     cpu_rate = cpu_result["tweets_per_sec"] if cpu_result else None
+    # serving-plane record (ISSUE 9; TWTML_BENCH_SERVING=0 skips): a short
+    # paired child — ~1 minute against the headline's 600 s budget — so the
+    # one JSON line also answers "what does the read path sustain?"
+    serving_result = None
+    if os.environ.get("TWTML_BENCH_SERVING", "1") != "0":
+        serving_result, serving_err = _run_child("serving", 300.0)
+        if serving_result is None:
+            serving_result = {"error": serving_err}
 
     record: dict
     if device_result:
@@ -220,6 +252,10 @@ def main() -> None:
             "vs_baseline": None,
             "note": f"device: {device_err}; cpu: {cpu_err}",
         }
+    if serving_result is not None:
+        # the serving plane's sustained read-path record (see the "serving"
+        # child above; full paired harness: tools/bench_serving.py)
+        record["serving"] = serving_result
     print(json.dumps(record))
 
 

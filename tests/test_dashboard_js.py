@@ -61,6 +61,11 @@ def dashboard(defer_series=False):
         "lossTrend": 0.0, "weightNorm": 0.0, "updateNorm": 0.0,
         "gradNorm": 0.0, "mse": [], "tenants": [], "episodes": 0,
     }
+    h.fetch_routes["/api/serving"] = {
+        "jsonClass": "Serving", "qps": 0.0, "rowsPerSec": 0.0,
+        "p50Ms": 0.0, "p95Ms": 0.0, "p99Ms": 0.0, "snapshotStep": -1,
+        "level": "", "requests": 0, "rows": 0, "errors": 0, "tenants": [],
+    }
     series = h.defer("/api/series") if defer_series else None
     if not defer_series:
         h.fetch_routes["/api/series"] = []
@@ -382,6 +387,65 @@ def test_metrics_backfill_fetched_on_boot():
     assert "/api/hosts" in urls
     assert "/api/tenants" in urls
     assert "/api/model" in urls
+    assert "/api/serving" in urls
+
+
+# ---------------------------------------------------------------------------
+# serving plane tiles (ISSUE 9, mirrors the Hosts/Tenants suites)
+
+def test_serving_frame_updates_tiles_and_level_badge():
+    """Serving tiles: QPS/latency numbers, the active snapshot id, the
+    snapshot-health badge class, and the error highlight."""
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="Serving", qps=512.46, rowsPerSec=8200.0, p50Ms=8.24,
+        p95Ms=61.0, p99Ms=84.06, snapshotStep=640, level="warn",
+        requests=10000, rows=160000, errors=0, tenants=[],
+    ))
+    assert h.el("serveQps").text == "512.5"
+    assert h.el("serveRows").text == "8,200"
+    assert h.el("serveP50").text == "8.2"
+    assert h.el("serveP99").text == "84.1"
+    assert h.el("serveSnapshot").text == "ckpt-640"
+    assert h.el("serveLevel").text == "warn"
+    assert "warn" in h.el("serveLevel").class_set
+    assert "ok" not in h.el("serveLevel").class_set
+    assert h.el("serveErrors").text == "0"
+    assert "degraded" not in h.el("serveErrors").class_set
+
+
+def test_serving_frame_errors_highlight_and_tenant_tiles():
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="Serving", qps=10.0, rowsPerSec=160.0, p50Ms=5.0,
+        p95Ms=9.0, p99Ms=12.0, snapshotStep=8, level="ok",
+        requests=50, rows=800, errors=3,
+        tenants=[{"tenant": 0, "rows": 500}, {"tenant": 1, "rows": 300}],
+    ))
+    assert "ok" in h.el("serveLevel").class_set
+    assert h.el("serveErrors").text == "3"
+    assert "degraded" in h.el("serveErrors").class_set
+    tiles = h.el("servingTenantsPanel").children
+    assert len(tiles) == 2
+    assert tiles[0].children[0].text == "tenant 0"
+    assert tiles[0].children[1].text == "500 rows"
+    assert tiles[1].children[1].text == "300 rows"
+
+
+def test_serving_empty_view_is_placeholder():
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="Serving", qps=0.0, rowsPerSec=0.0, p50Ms=0.0, p95Ms=0.0,
+        p99Ms=0.0, snapshotStep=-1, level="", requests=0, rows=0, errors=0,
+        tenants=[],
+    ))
+    assert h.el("serveQps").text == "—"
+    assert h.el("serveSnapshot").text == "—"
+    assert h.el("serveLevel").text == "—"
+    assert h.el("servingTenantsPanel").children == []
 
 
 def test_unknown_jsonclass_is_ignored():

@@ -213,6 +213,44 @@ def test_metrics_roundtrip_carries_derived_histograms(server):
     assert legacy.histograms == {}
 
 
+def test_serving_roundtrip_and_default(server):
+    """Additive Serving messages (the serve-plane view): cached last-value
+    like Metrics, served at /api/serving, unknown to legacy caches; the
+    predict front door answers 503 when no plane is attached."""
+    _, url, _ = server
+    import urllib.error
+    import urllib.request
+
+    with urllib.request.urlopen(url + "/api/serving", timeout=2) as resp:
+        empty = json.loads(resp.read())
+    assert empty["jsonClass"] == "Serving"
+    assert empty["snapshotStep"] == -1 and empty["tenants"] == []
+
+    client = WebClient(url)
+    client.serving({
+        "qps": 512.5, "rowsPerSec": 8200.0, "p50Ms": 8.2, "p95Ms": 61.0,
+        "p99Ms": 84.0, "snapshotStep": 640, "level": "warn",
+        "requests": 10000, "rows": 160000, "errors": 2,
+        "tenants": [{"tenant": 0, "rows": 90000},
+                    {"tenant": 1, "rows": 70000}],
+    })
+    with urllib.request.urlopen(url + "/api/serving", timeout=2) as resp:
+        got = json.loads(resp.read())
+    assert got["qps"] == 512.5 and got["p99Ms"] == 84.0
+    assert got["snapshotStep"] == 640 and got["level"] == "warn"
+    assert got["tenants"][1]["rows"] == 70000
+
+    # POST /api/predict without an attached plane: 503 with a JSON error
+    req = urllib.request.Request(
+        url + "/api/predict", data=b'{"rows": [{"text": "x"}]}',
+        headers={"content-type": "application/json"}, method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(req, timeout=2)
+    assert exc_info.value.code == 503
+    assert "serving" in json.loads(exc_info.value.read())["error"]
+
+
 def test_http_post_broadcasts_to_websockets(server):
     _, url, _ = server
     ws_url = url.replace("http://", "ws://") + "/api"

@@ -50,6 +50,7 @@ CONFIGS = [
     "sharded_dp4_logistic",
     "sharded_2e18_2d",
     "multi_tenant_m8",
+    "serving_qps",
 ]
 
 
@@ -458,6 +459,29 @@ def run_config(name: str, n_tweets: int, batch_size: int = 0) -> dict:
         out.update(_pipeline_rate(model, feat, statuses, batch_size,
                                   ragged=True, pack=False))
         out["tenants"] = 8
+    elif name == "serving_qps":
+        # the serving plane (ISSUE 9): coalesced + depth-8 pipelined
+        # inference vs naive per-request, paired on tools/pairedbench.py
+        # with the 70 ms modeled-RTT control (the acceptance regime —
+        # tools/bench_serving.py is the full harness; this is its compact
+        # per-config form for the suite's one-line-per-config record)
+        from tools.bench_serving import measure as serving_measure
+
+        rec = serving_measure(
+            requests=64, rows_per_request=16, batch_rows=256, depth=8,
+            budget=30.0, model_rtt_ms=70.0,
+        )
+        out.update({
+            "qps_pipelined": rec["pipelined_rtt"]["qps_median"],
+            "qps_naive": rec["naive_rtt"]["qps_median"],
+            "p99_ms": rec["pipelined_rtt"]["p99_ms"],
+            "paired_speedup_rtt70": (
+                rec["pipelined_rtt"]["paired_speedup_vs_naive"]
+            ),
+            "paired_speedup_cpu_control": (
+                rec["pipelined"]["paired_speedup_vs_naive"]
+            ),
+        })
     elif name in ("sharded_dp4", "sharded_dp4_logistic", "sharded_2e18_2d"):
         from twtml_tpu.parallel import ParallelSGDModel, make_mesh
         from twtml_tpu.parallel.sharding import shard_batch

@@ -12,7 +12,14 @@ Checkpoints saved before the quality stamp existed render as "(unstamped)"
 and do not fail the check. ``--json`` emits the history as one
 machine-readable line.
 
-Usage: python tools/model_report.py CHECKPOINT_DIR [--json]
+``--gate`` (ISSUE 9) turns the report into the PROMOTION GATE: exit 0 when
+the newest VERIFIED checkpoint is servable (finite + quality level <= warn),
+1 when it is not (alert-stamped, quarantined-only, or no verified archive at
+all), 2 on a malformed directory. The predicate is IMPORTED from
+``twtml_tpu.serving.snapshot`` — the exact function the serving plane's
+promoter runs — so an ops script's yes/no and the server can never disagree.
+
+Usage: python tools/model_report.py CHECKPOINT_DIR [--json] [--gate]
 """
 
 from __future__ import annotations
@@ -103,13 +110,45 @@ def render(rows: list[dict]) -> str:
     return "\n".join(out)
 
 
+def gate(directory: str, as_json: bool = False) -> int:
+    """The promotion gate: 0 = the newest verified checkpoint is servable,
+    1 = it is not, 2 = malformed directory. Runs the serving plane's OWN
+    predicate (``twtml_tpu.serving.snapshot`` — jax-free import)."""
+    from twtml_tpu.serving.snapshot import load_servable
+
+    # malformed directories stay exit 2 (the report contract); a directory
+    # that parses but holds nothing servable is a clean "no" (exit 1)
+    try:
+        load_history(directory)
+    except (OSError, MalformedHistory) as exc:
+        print(f"model_report: malformed history: {exc}", file=sys.stderr)
+        return 2
+    snapshot, reason = load_servable(directory)
+    verdict = {
+        "promotable": snapshot is not None,
+        "reason": reason,
+        "step": snapshot.step if snapshot is not None else None,
+        "tenants": snapshot.num_tenants if snapshot is not None else 0,
+    }
+    if as_json:
+        print(json.dumps(verdict))
+    elif snapshot is not None:
+        print(f"PROMOTABLE: step {snapshot.step} — {reason}")
+    else:
+        print(f"NOT PROMOTABLE: {reason}")
+    return 0 if snapshot is not None else 1
+
+
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
     as_json = "--json" in args
-    args = [a for a in args if a != "--json"]
+    as_gate = "--gate" in args
+    args = [a for a in args if a not in ("--json", "--gate")]
     if len(args) != 1:
         print(__doc__, file=sys.stderr)
         return 2
+    if as_gate:
+        return gate(args[0], as_json=as_json)
     try:
         rows = load_history(args[0])
     except (OSError, MalformedHistory) as exc:

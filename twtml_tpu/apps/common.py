@@ -1525,6 +1525,7 @@ class FetchPipeline:
             deadline_s=fetch_deadline_s, retries=fetch_retries,
         )
         self._pending: list = []  # [(future, out, batch, t)] oldest first
+        self._head_since = None  # poll()'s head-fetch deadline bookkeeping
         self._dispatched = 0
         # checkpoint cadence runs on its own MONOTONIC counter: a
         # refund_dispatch must not make the cap accounting pass a cadence
@@ -1640,6 +1641,44 @@ class FetchPipeline:
         max-batches cap, or capped runs under-train)."""
         self._dispatched -= 1
         self._refund_count.inc()
+
+    @property
+    def pending_fetches(self) -> int:
+        """In-flight pooled fetches (the serving plane's idle loop reads
+        this to pick its poll cadence)."""
+        return len(self._pending)
+
+    def poll(self) -> None:
+        """Emit any already-completed in-order results WITHOUT dispatching —
+        the serving plane's idle tick, so predictions deliver promptly when
+        no new request arrives to trigger the on_batch emit path. Skipped
+        in deterministic (multi-host lockstep) mode for the same reason the
+        opportunistic early emit is: wall-clock-dependent ``done()`` must
+        not drive side effects there.
+
+        The watchdog deadline holds here too: a head fetch that outlives
+        it with NO follow-up traffic (the idle-server wedged-tunnel case)
+        is emitted through the BLOCKING path, whose watchdog re-issues and
+        eventually aborts — without this, a stalled fetch on a quiet
+        serving plane would hang its clients until the next request."""
+        if self.deterministic:
+            return
+        while self._pending and self._pending[0][0].done():
+            self._emit_one()
+        if not self._pending:
+            self._head_since = None
+            return
+        import time as _time
+
+        head = self._pending[0][0]
+        now = _time.monotonic()
+        since = getattr(self, "_head_since", None)
+        if since is None or since[0] is not head:
+            self._head_since = (head, now)
+            return
+        if now - since[1] > self._watchdog.deadline():
+            self._head_since = None
+            self._emit_one()  # blocking: the watchdog owns it from here
 
     def flush(self) -> None:
         try:

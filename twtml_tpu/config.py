@@ -185,6 +185,21 @@ class ConfArguments:
             )
         self.sentinelRollbacks: int = int(conf.get("sentinelRollbacks", "3"))
         self.sentinelWindow: int = int(conf.get("sentinelWindow", "512"))
+        # serving plane (r12): batched, pipelined low-latency inference
+        # from verified snapshots (twtml_tpu/serving/, apps/serve.py)
+        self.servePort: int = int(conf.get("servePort", "8888"))
+        self.serveBatchRows: int = int(conf.get("serveBatchRows", "256"))
+        if self.serveBatchRows < 1:
+            raise ValueError(
+                f"serveBatchRows must be >= 1, got {self.serveBatchRows}"
+            )
+        self.serveMaxWaitMs: float = float(conf.get("serveMaxWaitMs", "5.0"))
+        self.serveDepth: int = int(conf.get("serveDepth", "8"))
+        if self.serveDepth < 1:
+            raise ValueError(f"serveDepth must be >= 1, got {self.serveDepth}")
+        self.servePromoteEvery: float = float(
+            conf.get("servePromoteEvery", "5.0")
+        )
         # model & data observability plane (r11): in-step quality telemetry
         self.modelWatch: str = conf.get("modelWatch", "on")
         if self.modelWatch not in ("on", "off"):
@@ -391,6 +406,29 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                                                (tests/test_blockwire.py). auto = on whenever
                                                the effective wire is ragged; off = the legacy
                                                ParsedBlock parser. Default: {self.blockWire}
+  --servePort <int>                            Serving entry point (apps/serve.py): port the
+                                               in-process web server (dashboard + POST
+                                               /api/predict front door) listens on.
+                                               Default: {self.servePort}
+  --serveBatchRows <int rows>                  Serving coalescer: dispatch a predict batch
+                                               once this many rows are admitted (the padded
+                                               row bucket of the predict program; requests
+                                               larger than this are rejected).
+                                               Default: {self.serveBatchRows}
+  --serveMaxWaitMs <float ms>                  Serving coalescer: bounded admission latency —
+                                               dispatch a partial batch once the OLDEST
+                                               admitted request has waited this long.
+                                               Default: {self.serveMaxWaitMs}
+  --serveDepth <int>                           Concurrent in-flight predict-result fetches
+                                               (the measured 6.2x-at-depth-8 transport
+                                               pipelining, BENCHMARKS r3).
+                                               Default: {self.serveDepth}
+  --servePromoteEvery <float seconds>          Snapshot promoter poll cadence over
+                                               --checkpointDir (new verified checkpoints
+                                               hot-swap in if their quality stamp is
+                                               ok/warn; alert refuses — the
+                                               tools/model_report.py --gate predicate).
+                                               Default: {self.servePromoteEvery}
   --wirePack <auto|stacked|group>              Superbatch wire layout on the ragged wire:
                                                'group' coalesces the K batches into ONE
                                                contiguous buffer (one put; uint16-delta offsets)
@@ -527,6 +565,20 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
             self.sentinelRollbacks = int(take())
         elif flag == "--sentinelWindow":
             self.sentinelWindow = int(take())
+        elif flag == "--servePort":
+            self.servePort = int(take())
+        elif flag == "--serveBatchRows":
+            self.serveBatchRows = int(take())
+            if self.serveBatchRows < 1:
+                self.printUsage(1)
+        elif flag == "--serveMaxWaitMs":
+            self.serveMaxWaitMs = float(take())
+        elif flag == "--serveDepth":
+            self.serveDepth = int(take())
+            if self.serveDepth < 1:
+                self.printUsage(1)
+        elif flag == "--servePromoteEvery":
+            self.servePromoteEvery = float(take())
         elif flag == "--modelWatch":
             self.modelWatch = take()
             if self.modelWatch not in ("on", "off"):

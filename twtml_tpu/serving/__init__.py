@@ -1,0 +1,53 @@
+"""Serving plane: batched, pipelined low-latency inference from verified
+snapshots (ISSUE 9 / ROADMAP item 1).
+
+The reference's entire upper half (SURVEY §1, L4-L6) is a live *read* path —
+the trained model exists to answer queries — but through PR 8 prediction only
+existed fused inside the train step. This package splits it out as a product:
+
+- ``snapshot``  — verified-checkpoint snapshots + the ONE promotion predicate
+                  (finite + quality level <= warn) shared with
+                  ``tools/model_report.py --gate``, and the hot-swap promoter;
+- ``engine``    — the jitted predict-only program over a device-resident
+                  snapshot (the fused train step with ``num_iterations=0``:
+                  the SAME traced prediction prologue, so serve-path
+                  predictions are BIT-identical to the train step's
+                  pre-update predictions — the parity law on the read path);
+- ``plane``     — the bounded-latency request coalescer + depth-K pipelined
+                  result fetches through ``apps/common.FetchPipeline`` (the
+                  measured 6.2x-at-depth-8 transport trick, BENCHMARKS r3);
+- ``client``    — the library-level HTTP client (``POST /api/predict``) for
+                  load generation and ops scripts.
+
+Import discipline: ``snapshot`` and ``client`` are jax-free (ops tools —
+``tools/model_report.py --gate`` — must not initialize a backend to answer
+"is this checkpoint servable?"); the engine/plane import jax lazily via
+``__getattr__``.
+"""
+
+from __future__ import annotations
+
+from .client import ServingClient
+from .snapshot import (
+    ServingSnapshot,
+    SnapshotPromoter,
+    is_promotable,
+    load_servable,
+)
+
+__all__ = [
+    "ServingClient",
+    "ServingPlane",
+    "ServingSnapshot",
+    "SnapshotPromoter",
+    "is_promotable",
+    "load_servable",
+]
+
+
+def __getattr__(name: str):
+    if name == "ServingPlane":  # lazy: pulls in jax via the model layer
+        from .plane import ServingPlane
+
+        return ServingPlane
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

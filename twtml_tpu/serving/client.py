@@ -1,0 +1,77 @@
+"""Library-level client for the serving front door (``POST /api/predict``).
+
+Same stdlib-urllib shape as ``telemetry/web_client.py`` — no external HTTP
+dependency — but predict calls RAISE on failure instead of the telemetry
+client's best-effort ``Try`` semantics: a load generator or an ops script
+must see a refused/aborted predict, not silently drop it. The paired serving
+bench (``tools/bench_serving.py``) and the serve-smoke tests drive this
+client as their load face.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+DEFAULT_SERVER = "http://localhost:8888"
+
+
+class ServingError(RuntimeError):
+    """A predict request failed server-side (watchdog abort, bad rows, or
+    serving not attached); ``status`` carries the HTTP code when known."""
+
+    def __init__(self, message: str, status: int = 0):
+        super().__init__(message)
+        self.status = status
+
+
+class ServingClient:
+    def __init__(self, server: str = "", timeout: float = 10.0):
+        self.server = server or DEFAULT_SERVER
+        self.timeout = timeout
+
+    def predict(self, rows) -> dict:
+        """POST rows (each a dict with ``text`` + optional author numerics,
+        or a bare string) to ``/api/predict``; returns the response dict:
+        ``{"predictions": [...], "snapshotStep": N, "servedRows": n}``."""
+        body = json.dumps({"rows": list(rows)}).encode("utf-8")
+        req = urllib.request.Request(
+            self.server + "/api/predict",
+            data=body,
+            headers={
+                "content-type": "application/json",
+                "accept": "application/json",
+            },
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as exc:
+            detail = ""
+            try:
+                detail = json.loads(exc.read().decode("utf-8")).get("error", "")
+            except Exception:
+                pass
+            raise ServingError(
+                detail or f"predict failed: HTTP {exc.code}", status=exc.code
+            ) from exc
+        except urllib.error.URLError as exc:
+            raise ServingError(f"predict failed: {exc.reason}") from exc
+
+    def predict_texts(self, texts) -> "list[float]":
+        """Convenience: predict bare texts, return just the predictions."""
+        return [
+            float(v)
+            for v in self.predict([{"text": t} for t in texts])["predictions"]
+        ]
+
+    def serving(self) -> dict:
+        """GET the latest ``Serving`` telemetry view (``/api/serving``)."""
+        req = urllib.request.Request(
+            self.server + "/api/serving",
+            headers={"accept": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return json.loads(resp.read().decode("utf-8"))

@@ -1,0 +1,194 @@
+"""Serving snapshots: verified checkpoints + the ONE promotion predicate.
+
+A snapshot is the weight state of a VERIFIED checkpoint (CRC'd, finite —
+checkpoint/checkpointer.py already refuses corrupt and non-finite archives at
+restore), gated on the quality stamp PR 8 writes into every checkpoint meta
+(``meta["quality"]``, tools/model_report.py renders the history): ``ok`` and
+``warn`` snapshots serve, ``alert`` refuses. ``is_promotable`` is that
+predicate — tools/model_report.py ``--gate`` imports THIS function, so an ops
+script's yes/no and the server's promoter can never disagree.
+
+The promoter is a polling thread over the checkpoint directory (the train
+process writes, the serve process reads — decoupled through the filesystem,
+ZERO fetches against the training device path): a new promotable step
+hot-swaps through ``ServingPlane.hot_swap``, which applies it between
+dispatches so an in-flight batch is never torn (serving/plane.py).
+
+jax-free on purpose: the gate tool answers "is this checkpoint servable?"
+without initializing any backend.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..utils import get_logger
+
+log = get_logger("serving.snapshot")
+
+# quality levels that may serve (the PR 8 graduated ladder); anything else —
+# today only "alert" — refuses promotion. Unstamped checkpoints (saved with
+# --modelWatch off, or predating the stamp) carry no evidence of trouble and
+# stay servable: the stamp gates on KNOWN bad health, it is not a required
+# certificate.
+SERVABLE_LEVELS = ("ok", "warn")
+
+
+def is_promotable(meta: "dict | None") -> "tuple[bool, str]":
+    """THE promotion predicate over a verified checkpoint's meta:
+    (servable?, reason). Shared verbatim by the serving promoter and
+    ``tools/model_report.py --gate`` so ops scripts and the serving plane
+    can never disagree.
+
+    ``meta`` is the checkpoint meta dict (restore() already verified the
+    archive bytes; the ``finite`` flag is re-checked here so a caller
+    holding only the meta — the gate tool — reaches the same verdict)."""
+    if not isinstance(meta, dict):
+        return False, "no checkpoint meta"
+    if not meta.get("finite", True):
+        return False, "non-finite weights (quarantined save)"
+    quality = meta.get("quality")
+    if quality is None:
+        return True, "servable (unstamped — no quality evidence against it)"
+    level = str(quality.get("level", "ok"))
+    if level not in SERVABLE_LEVELS:
+        return False, (
+            f"quality level {level!r} (drift z "
+            f"{float(quality.get('drift_score', 0.0)):.2f}, loss trend "
+            f"{float(quality.get('loss_trend', 0.0)) * 100:+.1f}%)"
+        )
+    return True, f"servable (quality level {level!r})"
+
+
+@dataclass
+class ServingSnapshot:
+    """One device-promotable weight state. ``weights`` is the checkpoint's
+    host array — ``[F+4]`` single-model or the PR 7 tenant stack
+    ``[M, F+4]`` (``num_tenants`` reads the stack width)."""
+
+    step: int
+    weights: np.ndarray
+    meta: dict = field(default_factory=dict)
+
+    @property
+    def num_tenants(self) -> int:
+        return int(self.weights.shape[0]) if self.weights.ndim == 2 else 1
+
+    @property
+    def quality_level(self) -> str:
+        quality = self.meta.get("quality") or {}
+        return str(quality.get("level", ""))
+
+    @property
+    def snapshot_id(self) -> str:
+        return f"ckpt-{self.step}"
+
+
+def load_servable(directory: str) -> "tuple[ServingSnapshot | None, str]":
+    """(newest VERIFIED checkpoint as a snapshot, reason) — or (None, why).
+
+    The verified half (CRC + finiteness fallback) is ``Checkpointer.restore``;
+    the quality half is ``is_promotable`` on its meta. A newest-verified
+    checkpoint that FAILS the quality gate returns (None, reason): the
+    promoter's contract is "serve the newest healthy state", not "skip back
+    to whatever old state still looks healthy" — a sustained alert should
+    hold the CURRENT snapshot, loudly, until training recovers."""
+    from ..checkpoint import Checkpointer
+
+    restored = Checkpointer(directory).restore()
+    if restored is None:
+        return None, f"no verified checkpoint in {directory!r}"
+    state, meta = restored
+    if isinstance(state, dict):
+        # flat-dict states (k-means centers etc.) have no serving program
+        return None, (
+            "checkpoint state is a pytree, not an SGD weight vector — "
+            "not servable by the SGD predict program"
+        )
+    ok, reason = is_promotable(meta)
+    if not ok:
+        return None, f"step {meta.get('step', '?')} refused: {reason}"
+    return (
+        ServingSnapshot(
+            step=int(meta.get("step", 0)),
+            weights=np.asarray(state),
+            meta=dict(meta),
+        ),
+        reason,
+    )
+
+
+class SnapshotPromoter:
+    """Background promotion: poll the checkpoint directory every ``poll_s``
+    and hand any NEW promotable step to ``plane.hot_swap`` (atomic — the
+    plane applies it between dispatches). Refusals (alert-stamped or
+    non-finite newest) are counted and logged ONCE per refused step; the
+    plane keeps serving its current snapshot.
+
+    Disk-only by design: promotion never touches a device or issues a host
+    fetch, so a co-located trainer's transport path is untouched (the
+    zero-added-train-fetches acceptance, tests/test_serving.py)."""
+
+    def __init__(self, directory: str, plane, poll_s: float = 5.0):
+        from ..telemetry import metrics as _metrics
+
+        self.directory = directory
+        self.plane = plane
+        self.poll_s = max(0.05, float(poll_s))
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self._refused_step: "int | None" = None
+        reg = _metrics.get_registry()
+        self._promotions = reg.counter("serve.promotions")
+        self._refused = reg.counter("serve.promotions_refused")
+
+    def poll_once(self) -> bool:
+        """One promotion check; True when a hot-swap happened. Exposed for
+        tests and for the serve app's startup (first snapshot synchronous)."""
+        from ..checkpoint import Checkpointer
+
+        latest = Checkpointer(self.directory).latest_step()
+        current = self.plane.snapshot_step
+        if latest is None or latest <= current:
+            return False
+        snap, reason = load_servable(self.directory)
+        if snap is None:
+            if self._refused_step != latest:
+                self._refused_step = latest
+                self._refused.inc()
+                log.warning(
+                    "snapshot promotion REFUSED (serving stays on step %d): "
+                    "%s", current, reason,
+                )
+            return False
+        if snap.step <= current:
+            return False
+        self.plane.hot_swap(snap)
+        self._promotions.inc()
+        self._refused_step = None
+        log.info(
+            "promoted snapshot step %d -> %d (%s)", current, snap.step, reason
+        )
+        return True
+
+    def start(self) -> "SnapshotPromoter":
+        self._thread = threading.Thread(
+            target=self._loop, name="twtml-serve-promoter", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.poll_once()
+            except Exception:
+                log.exception("snapshot promotion poll failed; retrying")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -130,9 +130,34 @@ class ModelHealth:
     json_class = "ModelHealth"
 
 
+@dataclass
+class Serving:
+    """Serving-plane view — an ADDITIVE message type (no reference
+    equivalent; the reference never served its model). QPS/latency over the
+    rolling serve window, the active snapshot (step + its checkpoint
+    quality level), cumulative request/row/error totals, and per-tenant
+    served-row counts on the multi-tenant plane (serving/plane.py
+    ``stats()``). Legacy dashboards ignore it like the other additive
+    types."""
+
+    qps: float = 0.0
+    rowsPerSec: float = 0.0
+    p50Ms: float = 0.0
+    p95Ms: float = 0.0
+    p99Ms: float = 0.0
+    snapshotStep: int = -1
+    level: str = ""
+    requests: int = 0
+    rows: int = 0
+    errors: int = 0
+    tenants: list = field(default_factory=list)
+
+    json_class = "Serving"
+
+
 TYPES = {"Config": Config, "Stats": Stats, "Series": Series,
          "Metrics": Metrics, "Hosts": Hosts, "Tenants": Tenants,
-         "ModelHealth": ModelHealth}
+         "ModelHealth": ModelHealth, "Serving": Serving}
 
 
 def encode(obj: Config | Stats) -> str:

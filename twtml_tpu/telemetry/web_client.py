@@ -12,8 +12,8 @@ from __future__ import annotations
 import urllib.request
 
 from .api_types import (
-    Config, Hosts, Metrics, ModelHealth, Series, Stats, Tenants, decode,
-    encode,
+    Config, Hosts, Metrics, ModelHealth, Series, Serving, Stats, Tenants,
+    decode, encode,
 )
 
 DEFAULT_SERVER = "http://localhost:8888"  # WebClient.scala:13
@@ -111,6 +111,12 @@ class WebClient:
             mse=[float(v) for v in (mse or [])],
             tenants=list(tenants or []), episodes=int(episodes),
         ))
+
+    def serving(self, view: dict) -> None:
+        """Push the serving-plane view (``ServingPlane.stats()``) for the
+        dashboard's Serving tile row (additive message; serving/plane.py)."""
+        known = Serving.__dataclass_fields__
+        self._post(Serving(**{k: v for k, v in view.items() if k in known}))
 
     # -- reads (WebClient.scala:40-46) ---------------------------------------
     def get_config(self) -> Config:

@@ -126,6 +126,49 @@
     }
   }
 
+  function onServing(json) {
+    // serving-plane tiles (serving/plane.py stats view): QPS + latency
+    // quantiles, the active snapshot (step + checkpoint quality level),
+    // error count, and per-tenant served-row tiles on the tenant plane
+    const hasSnapshot = Number(json.snapshotStep) >= 0;
+    document.getElementById("serveQps").textContent = hasSnapshot
+      ? Number(json.qps || 0).toFixed(1)
+      : "—";
+    document.getElementById("serveRows").textContent =
+      Number(json.rowsPerSec || 0).toLocaleString();
+    document.getElementById("serveP50").textContent =
+      Number(json.p50Ms || 0).toFixed(1);
+    document.getElementById("serveP99").textContent =
+      Number(json.p99Ms || 0).toFixed(1);
+    document.getElementById("serveSnapshot").textContent = hasSnapshot
+      ? "ckpt-" + json.snapshotStep
+      : "—";
+    const levelEl = document.getElementById("serveLevel");
+    const level = json.level || "—";
+    levelEl.textContent = level;
+    levelEl.classList.toggle("ok", level === "ok");
+    levelEl.classList.toggle("warn", level === "warn");
+    const errs = Number(json.errors || 0);
+    const errEl = document.getElementById("serveErrors");
+    errEl.textContent = String(errs);
+    errEl.classList.toggle("degraded", errs > 0);
+    const panel = document.getElementById("servingTenantsPanel");
+    panel.replaceChildren();
+    for (const t of json.tenants || []) {
+      const tile = document.createElement("div");
+      tile.className = "stat";
+      const label = document.createElement("div");
+      label.className = "label";
+      label.textContent = "tenant " + t.tenant;
+      const value = document.createElement("div");
+      value.className = "value";
+      value.textContent = Number(t.rows || 0).toLocaleString() + " rows";
+      tile.appendChild(label);
+      tile.appendChild(value);
+      panel.appendChild(tile);
+    }
+  }
+
   function drawLossSpark(values) {
     // rolling per-batch mse sparkline (ModelHealth.mse window)
     const canvas = document.getElementById("lossSpark");
@@ -205,6 +248,7 @@
       case "Hosts": onHosts(json); break;
       case "Tenants": onTenants(json); break;
       case "ModelHealth": onModelHealth(json); break;
+      case "Serving": onServing(json); break;
       case "Series":
         // live frames buffer until the history backfill lands (ordering)
         if (!backfilled) pendingSeries.push(json);
@@ -233,6 +277,8 @@
     fetch("/api/tenants").then((r) => r.json()).then(onTenants).catch(() => {});
     // model-health backfill (level "ok", empty sparkline until telemetry)
     fetch("/api/model").then((r) => r.json()).then(onModelHealth).catch(() => {});
+    // serving-plane backfill (snapshotStep -1 until a serve process posts)
+    fetch("/api/serving").then((r) => r.json()).then(onServing).catch(() => {});
     // backfill the chart from the server's rolling series window, then
     // apply any live frames that arrived while the fetch was in flight
     const flush = () => {

@@ -17,8 +17,8 @@ import collections
 import json as _json
 
 from ..telemetry.api_types import (
-    Config, Hosts, Metrics, ModelHealth, Series, Stats, Tenants, decode,
-    encode,
+    Config, Hosts, Metrics, ModelHealth, Series, Serving, Stats, Tenants,
+    decode, encode,
 )
 from ..utils import get_logger
 
@@ -39,6 +39,7 @@ class ApiCache:
         self._hosts = Hosts()
         self._tenants = Tenants()
         self._model = ModelHealth()
+        self._serving = Serving()
         self._series: collections.deque[Series] = collections.deque(
             maxlen=SERIES_WINDOW
         )
@@ -64,6 +65,10 @@ class ApiCache:
     def model(self) -> str:
         """Latest model-health view (in-memory only, like Stats)."""
         return encode(self._model)
+
+    def serving(self) -> str:
+        """Latest serving-plane view (in-memory only, like Stats)."""
+        return encode(self._serving)
 
     def series(self) -> str:
         """Recent Series messages as a JSON array (chart backfill for
@@ -95,6 +100,8 @@ class ApiCache:
             self._tenants = data
         elif isinstance(data, ModelHealth):
             self._model = data
+        elif isinstance(data, Serving):
+            self._serving = data
         elif isinstance(data, Series):
             self._series.append(data)
         else:

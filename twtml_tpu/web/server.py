@@ -52,6 +52,16 @@ class Server:
         self._runner: web.AppRunner | None = None
         self._started = threading.Event()
         self._assets = _res.files("twtml_tpu.web").joinpath("assets")
+        # serving front door (ISSUE 9): a ServingPlane attached by the
+        # serve entry point makes POST /api/predict live; without one the
+        # route answers 503 (this process has no model)
+        self._serving = None
+
+    def attach_serving(self, plane) -> "Server":
+        """Attach a ``serving.ServingPlane``: POST /api/predict submits to
+        its coalescer and awaits the pipelined result future."""
+        self._serving = plane
+        return self
 
     # -- handlers ------------------------------------------------------------
     async def _post_api(self, request: web.Request) -> web.StreamResponse:
@@ -83,6 +93,52 @@ class Server:
     async def _get_model(self, request: web.Request) -> web.StreamResponse:
         return web.Response(text=self.cache.model(),
                             content_type="application/json")
+
+    async def _get_serving(self, request: web.Request) -> web.StreamResponse:
+        return web.Response(text=self.cache.serving(),
+                            content_type="application/json")
+
+    async def _post_predict(self, request: web.Request) -> web.StreamResponse:
+        """The serving front door: coalesced, pipelined inference from the
+        attached plane's device-resident snapshot. Errors are JSON with an
+        ``error`` field — 503 when no plane is attached or the plane
+        aborted (wedged transport → watchdog abort, never a hang), 400 on a
+        malformed request body."""
+        def fail(status: int, message: str) -> web.Response:
+            return web.Response(
+                text=json.dumps({"error": message}), status=status,
+                content_type="application/json",
+            )
+
+        plane = self._serving
+        if plane is None:
+            return fail(503, "serving not enabled on this server "
+                             "(start via twtml_tpu.apps.serve)")
+        try:
+            payload = json.loads(await request.text())
+            rows = payload["rows"] if isinstance(payload, dict) else payload
+            if not isinstance(rows, list):
+                raise ValueError("body must be {\"rows\": [...]} ")
+            statuses = plane.statuses_from_rows(rows)
+        except (ValueError, KeyError, TypeError) as exc:
+            return fail(400, f"bad predict request: {exc}")
+        try:
+            # the plane's future resolves from the pipelined fetch pool;
+            # wrap_future bridges it into this event loop. The
+            # FetchWatchdog bounds how long it can possibly take.
+            result = await asyncio.wrap_future(plane.submit(statuses))
+        except ValueError as exc:  # oversized request
+            return fail(400, str(exc))
+        except Exception as exc:
+            return fail(503, str(exc))
+        return web.Response(
+            text=json.dumps({
+                "predictions": result["predictions"],
+                "snapshotStep": result["snapshot_step"],
+                "servedRows": len(result["predictions"]),
+            }),
+            content_type="application/json",
+        )
 
     async def _ws_api(self, request: web.Request) -> web.StreamResponse:
         ws = web.WebSocketResponse(heartbeat=30)
@@ -175,6 +231,8 @@ class Server:
         app.router.add_get("/api/hosts", self._get_hosts)  # lockstep fleet view
         app.router.add_get("/api/tenants", self._get_tenants)  # model plane
         app.router.add_get("/api/model", self._get_model)  # model health
+        app.router.add_get("/api/serving", self._get_serving)  # serve plane
+        app.router.add_post("/api/predict", self._post_predict)  # front door
         app.router.add_get("/", self._index)
         app.router.add_get("/{path:.+}", self._static)
         return app
