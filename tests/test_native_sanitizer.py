@@ -1,0 +1,47 @@
+"""Sanitized native differential (slow): tools/native_sanity.py under
+ASan+UBSan. The C parity fast paths get the same dynamic scrutiny as the
+Python side — memory errors abort the harness, semantic divergence exits 1.
+Skips where the toolchain can't produce an instrumented build."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DRIVER = os.path.join(_REPO, "tools", "native_sanity.py")
+
+
+def _runtime(name: str) -> str | None:
+    if shutil.which("g++") is None:
+        return None
+    out = subprocess.run(
+        ["g++", f"-print-file-name={name}"],
+        capture_output=True, text=True, timeout=30,
+    ).stdout.strip()
+    return out if os.path.sep in out and os.path.exists(out) else None
+
+
+@pytest.mark.parametrize("modes", ["ubsan", "asan,ubsan"])
+def test_native_differentials_under_sanitizers(modes):
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    for mode, rt in (("asan", "libasan.so"), ("ubsan", "libubsan.so")):
+        if mode in modes and _runtime(rt) is None:
+            pytest.skip(f"{rt} unavailable")
+    env = dict(os.environ)
+    env["TWTML_NATIVE_SANITIZE"] = modes
+    env.pop("TWTML_NATIVE_LIB", None)  # harness picks its own temp path
+    proc = subprocess.run(
+        [sys.executable, _DRIVER], env=env, cwd=_REPO,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, (
+        f"native_sanity({modes}) rc={proc.returncode}\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "PASS" in proc.stdout

@@ -328,10 +328,15 @@ def test_promoter_promotes_ok_and_refuses_alert(tmp_path):
         assert _metrics.get_registry().counter(
             "serve.promotions_refused").snapshot() == 1
 
-        # a healthy newer checkpoint hot-swaps in
+        # a healthy newer checkpoint hot-swaps in. poll_once STAGES the
+        # swap; the serve loop applies it between dispatches (the atomic-
+        # swap contract), so give its next tick a bounded moment to land
         w3 = np.full(1004, 0.25, np.float32)
         _save_ckpt(ck, 3, w3, level="warn")
         assert promoter.poll_once() is True
+        deadline = time.monotonic() + 10
+        while plane.snapshot_step != 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
         assert plane.snapshot_step == 3
 
         # served predictions now come from w3 (swap really landed)
@@ -553,8 +558,15 @@ def test_serving_adds_zero_train_fetches_and_keeps_training_bit_identical(
         jax.device_get = real_get
     assert totals["batches"] == 8
     assert calls["n"] == 8  # ONE fetch per train batch — serving added none
-    # the promoter reached the train run's newest verified checkpoint
-    promoter.poll_once()
+    # the promoter reached the train run's newest verified checkpoint.
+    # Promotion is STAGED (poll) and applied between serve-loop dispatches
+    # (the atomic-swap contract), so wait boundedly for the swap to land
+    deadline = time.monotonic() + 10
+    while plane.snapshot_step != totals["batches"] and (
+        time.monotonic() < deadline
+    ):
+        promoter.poll_once()
+        time.sleep(0.01)
     assert plane.snapshot_step == totals["batches"]
     promoter.stop()
     plane.stop()

@@ -1,0 +1,28 @@
+"""Law-checker: a repo-specific static analyzer for the measured laws.
+
+Nine PRs of benchmarking bought a set of *measured* transport/parity
+invariants — one counted fetch per tick, main-thread-only ``device_put``
+(the r2 throughput collapse), no scatter into 2^18 (the ~220 ns/update XLA
+serialization trap), Try-parity on publish paths, no module-scope backend
+init before the conftest mesh pin, the ``TWTML_NOW_MS`` determinism seam,
+and flag/doc sync. Each was enforced only by convention and a handful of
+runtime counting tests; a single unreviewed call site could silently
+reintroduce a failure mode that cost a benchmark round to discover. This
+package enforces them over the AST, in CI, before any TPU window is spent.
+
+One rule per law (``python -m tools.lawcheck --list-rules``); every finding
+message cites the BENCHMARKS.md/CLAUDE.md fact it encodes. Pure stdlib
+(``ast``), no jax import, no third-party deps.
+
+Usage::
+
+    python -m tools.lawcheck            # exit 0 clean / 1 findings / 2 malformed
+    python -m tools.lawcheck --json     # machine-readable findings
+    # lawcheck: disable=TW004 -- <reason>   (inline, reason REQUIRED)
+
+The checked-in baseline (``tools/lawcheck/baseline.json``) exists for
+grandfathered findings and is kept EMPTY on purpose: fix, don't baseline.
+"""
+
+from .engine import main, run_repo  # noqa: F401
+from .findings import Finding, Malformed  # noqa: F401

@@ -1,0 +1,5 @@
+"""``python -m tools.lawcheck`` — the CI gate entry point."""
+
+from .engine import main
+
+raise SystemExit(main())

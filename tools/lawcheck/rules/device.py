@@ -1,0 +1,59 @@
+"""Device-program law: no scatter in jitted step code (the Gram densify).
+
+The one XLA trap that cost a full benchmark round: a [B*L]-update scatter
+into the [B, 2^18] feature space runs ~220 ns/update SERIALIZED on this
+backend — the 2^18 sparse config only became viable when ops/gram.py
+replaced 50 scatters per batch with one [B, B] Gram matmul (one-hot
+two-level matmul densify, ~21 ms/step). Any ``.at[...].add/.set`` that
+creeps back into step code silently reopens that cliff, and nothing at
+runtime would flag it — the program still produces correct bits, just
+hundreds of times slower.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..findings import Finding
+from . import FileContext, Rule
+
+_SCATTER_METHODS = frozenset({
+    "add", "set", "mul", "multiply", "divide", "min", "max", "power",
+    "apply", "get",
+})
+
+
+class TW004Scatter(Rule):
+    id = "TW004"
+    title = "indexed-update scatter in jitted step code"
+    law = (
+        "a [B*L]-update scatter into [B, 2^18] runs ~220 ns/update "
+        "serialized on this backend; ops/gram.py's one-hot two-level "
+        "matmul densify replaced it (one [B,B] Gram matmul per batch, "
+        "~21 ms/step at 2^18) — scatters must not creep back into step "
+        "code (BENCHMARKS.md 'XLA perf traps'; CLAUDE.md). Bounded "
+        "small-domain scatters (K centers, fixed columns) are exempt via "
+        "an inline suppression stating the bound"
+    )
+
+    def check(self, ctx: FileContext):
+        if not (ctx.path.startswith("twtml_tpu/ops/")
+                or ctx.path.startswith("twtml_tpu/models/")):
+            return []
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            # X.at[idx].add(v): Call(func=Attribute(value=Subscript(
+            #   value=Attribute(attr='at')), attr='add'))
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SCATTER_METHODS
+                    and isinstance(node.func.value, ast.Subscript)
+                    and isinstance(node.func.value.value, ast.Attribute)
+                    and node.func.value.value.attr == "at"):
+                continue
+            findings.append(Finding(
+                self.id, ctx.path, node.lineno,
+                f".at[...].{node.func.attr}() indexed update in step code "
+                "— " + self.law,
+            ))
+        return findings

@@ -1,0 +1,130 @@
+"""Host-side laws: silent exception swallows, wall-clock determinism.
+
+TW005 — the reference's Try semantics *require* swallowing on telemetry
+publish paths (a sick dashboard must never kill the pipeline — that is
+Try-parity, PARITY.md), but the same ``except Exception: pass`` pattern
+anywhere else is how lost rows, wedged threads, and dead guards hide. The
+rule flags broad handlers that neither re-raise nor make any call (no log,
+no counter, no fallback work); Try-parity modules are exempt by an
+explicit per-file allowlist.
+
+TW006 — PR 4's sentinel acceptance test holds only because runs are
+replayable: the ``TWTML_NOW_MS`` env seam pins every clock that feeds
+features or batch identity (features/featurizer.py). Lockstep, sentinel,
+and serving code reading ``time.time()``/``datetime.now()`` directly
+bypasses the seam and breaks bit-replay of the exact paths whose
+correctness is proven by replay.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..findings import Finding
+from . import FileContext, Rule
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare except:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+    """No re-raise and no call AT ALL in the handler body: nothing is
+    logged, counted, or recovered — the failure simply vanishes."""
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Call)):
+            return False
+    return True
+
+
+class TW005SilentSwallow(Rule):
+    id = "TW005"
+    title = "silent broad exception swallow outside Try-parity modules"
+    law = (
+        "reference Try semantics require swallowing ONLY on telemetry "
+        "publish paths (a sick sink must never kill the pipeline — "
+        "PARITY.md Try-parity); anywhere else a silent 'except Exception' "
+        "is how lost rows and wedged guards hide. Log it, count it, or "
+        "narrow it; per-file exemptions are for publish paths only"
+    )
+    # Try-parity exempt: modules whose JOB is to swallow publish/telemetry
+    # failures, mirroring the reference's Try wrapping (PARITY.md).
+    # session_stats/web_client/lightning are the publish paths themselves;
+    # trace/blackbox/metrics sinks must never kill the pipeline either.
+    TRY_PARITY_FILES = frozenset({
+        "twtml_tpu/telemetry/session_stats.py",
+        "twtml_tpu/telemetry/web_client.py",
+        "twtml_tpu/telemetry/lightning.py",
+        "twtml_tpu/telemetry/trace.py",
+        "twtml_tpu/telemetry/blackbox.py",
+        "twtml_tpu/telemetry/metrics.py",
+    })
+
+    def check(self, ctx: FileContext):
+        if not ctx.path.startswith("twtml_tpu/"):
+            return []
+        if ctx.path in self.TRY_PARITY_FILES:
+            return []
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ExceptHandler) and _is_broad(node) and (
+                _is_silent(node)
+            ):
+                findings.append(Finding(
+                    self.id, ctx.path, node.lineno,
+                    "broad except swallows silently (no raise, no log, no "
+                    "counter) — " + self.law,
+                ))
+        return findings
+
+
+class TW006WallClock(Rule):
+    id = "TW006"
+    title = "raw wall clock in lockstep/sentinel/serving code"
+    law = (
+        "PR 4's sentinel acceptance test (poisoned run bit-equals clean "
+        "run minus the poisoned batch) and the serving parity tests hold "
+        "only under the TWTML_NOW_MS determinism seam; direct "
+        "time.time()/datetime.now() in these paths breaks bit-replay — "
+        "use utils/clock.now_ms()/now_s() (time.monotonic() for pure "
+        "intervals is fine and not flagged)"
+    )
+    # the deterministic-replay surfaces: the lockstep scheduler, the
+    # sentinel/delivery layer, and the serving plane
+    SCOPE = (
+        "twtml_tpu/streaming/context.py",
+        "twtml_tpu/apps/common.py",
+        "twtml_tpu/apps/serve.py",
+        "twtml_tpu/serving/",
+    )
+    _WALL_CLOCK = frozenset({
+        "time.time", "datetime.now", "datetime.utcnow",
+        "datetime.datetime.now", "datetime.datetime.utcnow",
+    })
+
+    def check(self, ctx: FileContext):
+        if not any(
+            ctx.path == s or (s.endswith("/") and ctx.path.startswith(s))
+            for s in self.SCOPE
+        ):
+            return []
+        from .transport import dotted
+
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and dotted(node.func) in (
+                self._WALL_CLOCK
+            ):
+                findings.append(Finding(
+                    self.id, ctx.path, node.lineno,
+                    f"{dotted(node.func)}() in deterministic-replay code "
+                    "bypasses the TWTML_NOW_MS seam — " + self.law,
+                ))
+        return findings

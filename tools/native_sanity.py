@@ -1,0 +1,294 @@
+"""Sanitized differential harness for the C parity fast paths.
+
+Builds ``native/*.cpp`` with ASan/UBSan instrumentation (honoring the
+``TWTML_NATIVE_SANITIZE`` seam in features/native.py) into a TEMP library
+— never clobbering the production ``.so`` — and drives the same
+differentials the parity law rests on, jax-free:
+
+- ``hash_texts`` vs the pure-Python ground truth (features/hashing.py:
+  char_bigrams + hashing_tf_counts), on an adversarial corpus (emoji,
+  lone surrogates, empties, 1-unit rows, long rows, seeded fuzz);
+- ``parse_tweet_block`` vs ``parse_tweet_block_wire`` byte-parity on
+  crafted JSONL blocks (unicode, garbage lines, truncated tails, the
+  retweet-count filter window);
+- ``pad_units`` (narrow + wide + ASCII fold) vs a numpy reference.
+
+Memory errors (OOB reads on ragged offsets, the classic parser bug class)
+abort with a sanitizer report; semantic divergence exits 1. Exit 0 = the
+instrumented library is parity-clean; exit 2 = environment cannot run the
+harness (no g++ / no sanitizer runtime) — callers decide whether that is
+fatal (CI: yes; the slow-marked test skips).
+
+ASan's runtime must be loaded before CPython itself, so when ``asan`` is
+requested the script re-execs itself once with ``LD_PRELOAD`` pointing at
+g++'s libasan (leak checking off: CPython "leaks" by design).
+
+Usage::
+
+    python tools/native_sanity.py                 # ubsan+asan (default)
+    TWTML_NATIVE_SANITIZE=ubsan python tools/native_sanity.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+import tempfile
+import types
+
+_REEXEC_MARK = "TWTML_NATIVE_SANITY_REEXEC"
+
+
+def _fail_env(msg: str) -> "int":
+    print(f"native_sanity: SKIP-ENV {msg}", file=sys.stderr)
+    return 2
+
+
+def _sanitizer_runtime(name: str) -> str | None:
+    try:
+        out = subprocess.run(
+            ["g++", f"-print-file-name={name}"],
+            capture_output=True, text=True, timeout=30, check=True,
+        ).stdout.strip()
+    except Exception:
+        return None
+    return out if os.path.sep in out and os.path.exists(out) else None
+
+
+def _maybe_reexec(modes: set[str]) -> None:
+    """Re-exec once with libasan preloaded when asan is requested (its
+    interceptors must initialize before CPython's first allocation)."""
+    if "asan" not in modes or os.environ.get(_REEXEC_MARK):
+        return
+    rt = _sanitizer_runtime("libasan.so")
+    if rt is None:
+        raise SystemExit(_fail_env("libasan.so not found via g++"))
+    env = dict(os.environ)
+    env[_REEXEC_MARK] = "1"
+    env["LD_PRELOAD"] = " ".join(
+        p for p in (rt, env.get("LD_PRELOAD", "")) if p
+    )
+    env.setdefault("ASAN_OPTIONS", "detect_leaks=0:abort_on_error=1")
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)]
+              + sys.argv[1:], env)
+
+
+def _stub_jax() -> None:
+    """features/__init__ registers two pytree nodes at import; the harness
+    never builds jax pytrees, and importing real jax under an ASan preload
+    drowns the report in uninstrumented-jaxlib noise — stub the one entry
+    point the import chain touches. A real already-imported jax wins."""
+    if "jax" in sys.modules:
+        return
+    fake = types.ModuleType("jax")
+    fake.tree_util = types.SimpleNamespace(
+        register_pytree_node=lambda *a, **k: None
+    )
+    sys.modules["jax"] = fake
+
+
+# ---------------------------------------------------------------------------
+# corpora
+
+
+def _texts_corpus() -> list[str]:
+    rng = random.Random(42)
+    crafted = [
+        "", "a", "aa", "plain ascii tweet about tpus",
+        "MiXeD CaSe ASCII with    spaces",
+        "héllo wörld",  # BMP latin-1 supplement
+        "こんにちは",  # CJK
+        "\U0001f600\U0001f680",  # astral emoji: surrogate-pair bigrams
+        "a\U0001f600b",
+        "\ud800",  # lone high surrogate (json.loads produces these)
+        "x\udfffy",  # lone low surrogate mid-string
+        "aa" * 2000,  # long row
+        "\t\n weird\x00控制 chars\x1f",
+    ]
+    alphabet = "abcdefghij éöあ\U0001f600"
+    fuzz = [
+        "".join(rng.choice(alphabet) for _ in range(rng.randrange(0, 80)))
+        for _ in range(200)
+    ]
+    return crafted + fuzz
+
+
+def _block_corpus() -> bytes:
+    rng = random.Random(7)
+
+    def rt(text, count=500, **extra):
+        inner = {"text": text, "retweet_count": count,
+                 "user": {"followers_count": rng.randrange(0, 10**6),
+                          "favourites_count": rng.randrange(0, 10**5),
+                          "friends_count": rng.randrange(0, 10**4)},
+                 "timestamp_ms": "1785313333333"}
+        inner.update(extra)
+        return {"text": "RT", "retweeted_status": inner}
+
+    lines: list[str] = []
+    for i in range(64):
+        lines.append(json.dumps(rt(f"plain ascii tweet {i}", count=100 + i)))
+    lines.append(json.dumps(rt("héllo été", count=150),
+                            ensure_ascii=False))
+    lines.append(json.dumps(rt("\U0001f600 emoji \U0001f680", count=151)))
+    lines.append(json.dumps(rt("edge counts", count=0)))
+    lines.append(json.dumps(rt("over the window", count=10**7)))
+    lines.append(json.dumps({"text": "no retweet here"}))  # filtered
+    lines.append("{garbage not json")  # bad line
+    lines.append("")  # blank
+    lines.append(json.dumps(rt("escaped \\\" quote \\u00e9", count=152)))
+    lines.append(json.dumps(rt("x" * 5000, count=153)))  # over kMaxTextUnits
+    return ("\n".join(lines) + "\n").encode("utf-8")
+
+
+# ---------------------------------------------------------------------------
+# differentials
+
+
+def _check_hash_parity(native, hashing, np) -> list[str]:
+    errors: list[str] = []
+    texts = [t.lower() for t in _texts_corpus()]
+    num_features = 2**18
+    encoded = native.encode_texts(texts)
+    lengths = np.diff(encoded[1])
+    l_max = max(64, int(lengths.max()))
+    idx = np.zeros((len(texts), l_max), dtype=np.int32)
+    val = np.zeros((len(texts), l_max), dtype=np.float32)
+    ntok = native.hash_texts(texts, num_features, idx, val, encoded=encoded)
+    if ntok is None:
+        return ["hash_texts returned None (fallback) on the corpus"]
+    for i, text in enumerate(texts):
+        want = hashing.hashing_tf_counts(
+            hashing.char_bigrams(text), num_features
+        )
+        got: dict[int, float] = {}
+        for j in range(l_max):
+            if val[i, j] != 0:
+                got[int(idx[i, j])] = got.get(int(idx[i, j]), 0.0) + float(
+                    val[i, j]
+                )
+        if got != want:
+            errors.append(
+                f"hash row {i} diverged from features/hashing.py "
+                f"(text={text[:40]!r}...)"
+            )
+    return errors
+
+
+def _check_pad_units(native, np) -> list[str]:
+    errors: list[str] = []
+    texts = [t.lower() for t in _texts_corpus()[:40]]
+    encoded = native.encode_texts(texts)
+    units, offsets = encoded
+    lengths = np.diff(offsets)
+    l_max = max(8, int(lengths.max()))
+    for narrow in (False, True):
+        if narrow and any(u > 0xFF for u in units.tolist()):
+            ascii_texts = [t for t in texts if t.isascii()]
+            enc = native.encode_texts(ascii_texts)
+        else:
+            ascii_texts, enc = texts, encoded
+        u, off = enc
+        n = len(ascii_texts)
+        got = native.pad_units(enc, n, n + 3, l_max, ascii_lower=False,
+                               narrow=narrow)
+        if got is None:
+            errors.append(f"pad_units(narrow={narrow}) returned None")
+            continue
+        buf, length = got
+        want_dtype = np.uint8 if narrow else np.uint16
+        if buf.dtype != want_dtype:
+            errors.append(f"pad_units(narrow={narrow}) dtype {buf.dtype}")
+        for i in range(n):
+            row = u[off[i]:off[i + 1]]
+            if int(length[i]) != len(row) or not (
+                buf[i, :len(row)].astype(np.uint16) == row.astype(np.uint16)
+            ).all() or buf[i, len(row):].any():
+                errors.append(f"pad_units(narrow={narrow}) row {i} mismatch")
+                break
+        if buf[n:].any() or length[n:].any():
+            errors.append(f"pad_units(narrow={narrow}) padding rows dirty")
+    return errors
+
+
+def _check_block_wire_parity(native, np) -> list[str]:
+    errors: list[str] = []
+    data = _block_corpus()
+    for begin, end in ((0, 2**62), (120, 160), (0, 1)):
+        legacy = native.parse_tweet_block(data, begin, end)
+        wire = native.parse_tweet_block_wire(data, begin, end)
+        if legacy is None or wire is None:
+            errors.append(f"parser unavailable (begin={begin})")
+            continue
+        l_num, l_units, l_off, l_ascii, l_cons, l_bad = legacy
+        w_num, w_units, w_off, w_ascii, w_cons, w_bad = wire
+        tag = f"[{begin},{end})"
+        if not (np.array_equal(l_num, w_num)
+                and np.array_equal(l_off, w_off)
+                and np.array_equal(l_ascii, w_ascii)
+                and l_cons == w_cons):
+            errors.append(f"block {tag}: legacy/wire metadata diverged")
+            continue
+        if not np.array_equal(
+            l_units.astype(np.uint16), w_units.astype(np.uint16)
+        ):
+            errors.append(f"block {tag}: unit payloads diverged")
+        if len(w_ascii) and w_ascii.all() and w_units.dtype != np.uint8:
+            errors.append(f"block {tag}: all-ASCII block not narrow")
+        # bad-line counts: the wire parser's keyless-line prescreen may
+        # UNDERCOUNT JSON-shaped lines with no "retweeted_status" key —
+        # the documented telemetry-only divergence (BENCHMARKS.md r9);
+        # kept-row payloads above are exact either way
+        if w_bad > l_bad:
+            errors.append(f"block {tag}: wire bad-count exceeds legacy "
+                          f"({w_bad} > {l_bad})")
+        # truncated tail: both parsers must stop at the same consumed byte
+        cut = data[: len(data) - 37]
+        lt = native.parse_tweet_block(cut, begin, end)
+        wt = native.parse_tweet_block_wire(cut, begin, end)
+        if lt[4] != wt[4] or wt[5] > lt[5]:
+            errors.append(f"block {tag}: truncated-tail consumed/bad differ")
+    return errors
+
+
+def main() -> int:
+    os.environ.setdefault("TWTML_NATIVE_SANITIZE", "asan,ubsan")
+    modes = {m.strip()
+             for m in os.environ["TWTML_NATIVE_SANITIZE"].split(",") if m}
+    _maybe_reexec(modes)
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    _stub_jax()
+
+    tmp = tempfile.mkdtemp(prefix="twtml-native-sanity-")
+    os.environ.setdefault(
+        "TWTML_NATIVE_LIB", os.path.join(tmp, "libfasthash_san.so")
+    )
+    import numpy as np
+
+    from twtml_tpu.features import hashing, native
+
+    if native.get_lib() is None:
+        return _fail_env("instrumented library failed to build/load "
+                         "(no g++, or sanitizer link failure)")
+    errors: list[str] = []
+    errors += _check_hash_parity(native, hashing, np)
+    errors += _check_pad_units(native, np)
+    errors += _check_block_wire_parity(native, np)
+    for e in errors:
+        print(f"native_sanity: FAIL {e}", file=sys.stderr)
+    print(
+        f"native_sanity: modes={','.join(sorted(modes)) or 'none'} "
+        f"lib={os.environ['TWTML_NATIVE_LIB']} "
+        f"{'FAIL ' + str(len(errors)) + ' differential(s)' if errors else 'PASS'}"
+    )
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -956,7 +956,7 @@ class ProcessRecycler:
         for h in list(log.handlers) or []:
             try:
                 h.flush()
-            except Exception:
+            except Exception:  # lawcheck: disable=TW005 -- best-effort log flush immediately before execv; a sick handler must not stop the recycle
                 pass
         _sys.stdout.flush()
         _sys.stderr.flush()
@@ -1030,7 +1030,7 @@ class FetchWatchdog:
                 return future.result(timeout=deadline)
             except _FutTimeout:
                 why = f"made no progress within its {deadline:.1f}s deadline"
-            except Exception as exc:
+            except Exception as exc:  # lawcheck: disable=TW005 -- not a swallow: the failure is captured into `why` and drives the watchdog's retry/abort machine below
                 why = f"failed ({exc!r})"
             attempts += 1
             if attempts > self.retries:

@@ -139,7 +139,7 @@ def _parse_created_at_ms(value: Any) -> int:
     except ValueError:
         try:
             return int(parsedate_to_datetime(s).timestamp() * 1000)
-        except Exception:
+        except Exception:  # lawcheck: disable=TW005 -- reference parse semantics: an unparsable created_at is 0, the Status-path ground truth (parity law: don't fix reference quirks)
             return 0
 
 
@@ -271,7 +271,7 @@ class Featurizer:
             if self.normalize_accents:
                 text = _strip_accents(text)
             return len(text.encode("utf-16-le", "surrogatepass")) // 2
-        except Exception:
+        except Exception:  # lawcheck: disable=TW005 -- documented degrade (docstring above): an unmeasurable row counts as over-long so lockstep overflow handling drops it instead of desyncing
             return 1 << 30
 
     def featurize_numbers(self, status: Status) -> np.ndarray:

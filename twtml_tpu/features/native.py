@@ -25,7 +25,42 @@ _SRCS = [
     os.path.join(_REPO_ROOT, "native", "fasthash.cpp"),
     os.path.join(_REPO_ROOT, "native", "tweetjson.cpp"),
 ]
-_LIB = os.path.join(_REPO_ROOT, "native", "libfasthash.so")
+# TWTML_NATIVE_LIB: alternate build/load path for the shared library. The
+# sanitizer harness (tools/native_sanity.py) builds an ASan/UBSan-
+# instrumented copy WITHOUT clobbering the production .so next to the
+# sources (a sanitized library needs its runtime preloaded — loading it
+# from a normal run would fail).
+_LIB = os.environ.get("TWTML_NATIVE_LIB", "") or os.path.join(
+    _REPO_ROOT, "native", "libfasthash.so"
+)
+
+
+def _build_flags() -> list[str]:
+    """Compile flags: full warnings always (the C parity fast paths get
+    the same scrutiny as the Python side); TWTML_NATIVE_SANITIZE adds
+    instrumented-build flags — comma-separated subset of {asan, ubsan},
+    e.g. ``TWTML_NATIVE_SANITIZE=asan,ubsan`` — at -O1 with frame
+    pointers so reports carry usable stacks."""
+    flags = ["-O3", "-march=native", "-shared", "-fPIC", "-pthread",
+             "-Wall", "-Wextra"]
+    san = os.environ.get("TWTML_NATIVE_SANITIZE", "")
+    if san:
+        modes = {m.strip() for m in san.split(",") if m.strip()}
+        unknown = modes - {"asan", "ubsan"}
+        if unknown:
+            log.warning(
+                "TWTML_NATIVE_SANITIZE=%s: unknown mode(s) %s ignored "
+                "(known: asan, ubsan)", san, ",".join(sorted(unknown)),
+            )
+            modes -= unknown
+        sanitizers = [s for m, s in (("asan", "address"),
+                                     ("ubsan", "undefined")) if m in modes]
+        if sanitizers:
+            flags = ["-O1", "-g", "-fno-omit-frame-pointer",
+                     f"-fsanitize={','.join(sanitizers)}",
+                     "-march=native", "-shared", "-fPIC", "-pthread",
+                     "-Wall", "-Wextra", "-Werror"]
+    return flags
 
 # the C data-loader's per-row text bound (kMaxTextUnits, native/tweetjson.cpp):
 # a retweeted status whose text/full_text exceeds this many UTF-16 units makes
@@ -61,8 +96,7 @@ def _build() -> bool:
     tmp = _LIB + ".tmp"
     try:
         subprocess.run(
-            ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-pthread",
-             "-o", tmp, *_SRCS],
+            ["g++", *_build_flags(), "-o", tmp, *_SRCS],
             check=True, capture_output=True, timeout=120,
         )
         os.replace(tmp, _LIB)

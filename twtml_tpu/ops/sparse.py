@@ -31,7 +31,7 @@ def densify_text(token_idx, token_val, num_text_features):
     b = token_idx.shape[0]
     rows = jnp.broadcast_to(jnp.arange(b)[:, None], token_idx.shape)
     dense = jnp.zeros((b, num_text_features), dtype=token_val.dtype)
-    return dense.at[rows, token_idx].add(token_val)
+    return dense.at[rows, token_idx].add(token_val)  # lawcheck: disable=TW004 -- dense-model densify for small F_text; the 2^18 config routes to ops/gram.py (the measured cliff is the [B,2^18] scatter)
 
 
 def sparse_text_dot(w_text, token_idx, token_val):
@@ -55,6 +55,6 @@ def sparse_grad_text(token_idx, token_val, residual, num_text_features):
     contrib = token_val * residual[:, None]  # [B, L]
     flat_idx = token_idx.reshape(-1)
     flat_contrib = contrib.reshape(-1)
-    return jnp.zeros((num_text_features,), dtype=token_val.dtype).at[flat_idx].add(
+    return jnp.zeros((num_text_features,), dtype=token_val.dtype).at[flat_idx].add(  # lawcheck: disable=TW004 -- the pre-Gram reference scatter: ground truth for the gram differential tests; use_gram routes the 2^18 config around it
         flat_contrib
     )

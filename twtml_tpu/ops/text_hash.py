@@ -42,7 +42,7 @@ def hash_bigrams_device(units, length, num_features: int, dtype=jnp.float32):
     h = 31 * c1 + c2
     # sliding(2) on a single-unit string yields that string itself: the
     # row's one term hashes to u0 (Java hashCode of a 1-char string).
-    h = h.at[:, 0].set(jnp.where(length == 1, u[:, 0], h[:, 0]))
+    h = h.at[:, 0].set(jnp.where(length == 1, u[:, 0], h[:, 0]))  # lawcheck: disable=TW004 -- fixed single-column update (static index 0), not a data-indexed scatter
     n_terms = jnp.where(length == 1, 1, jnp.maximum(length - 1, 0))
     valid = jnp.arange(h.shape[1], dtype=length.dtype)[None, :] < n_terms[:, None]
     token_idx = jnp.where(valid, h % num_features, 0)

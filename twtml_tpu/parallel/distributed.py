@@ -302,7 +302,7 @@ class MultiHostSGDModel:
 
         # the quality leaf (None when --modelWatch off — an empty pytree)
         # rides the same ONE pooled transfer as the scalar stats
-        count, mse, real_stdev, pred_stdev, quality = jax.device_get(
+        count, mse, real_stdev, pred_stdev, quality = jax.device_get(  # lawcheck: disable=TW002 -- fetch_output_many IS the counted seam: FetchPipeline installs it as _fetch_many, one pooled get per K-group tick
             (outs.count, outs.mse, outs.real_stdev, outs.pred_stdev,
              outs.quality)
         )
@@ -335,7 +335,7 @@ class MultiHostSGDModel:
         each is a full transport round trip, BENCHMARKS.md)."""
         from ..models.base import StepOutput
 
-        count, mse, real_stdev, pred_stdev, quality = jax.device_get(
+        count, mse, real_stdev, pred_stdev, quality = jax.device_get(  # lawcheck: disable=TW002 -- fetch_output IS the counted seam: FetchPipeline installs it as _fetch, one pooled get per tick (counted in tests/test_distributed_multiprocess.py)
             (out.count, out.mse, out.real_stdev, out.pred_stdev, out.quality)
         )
         return StepOutput(

@@ -52,7 +52,7 @@ class ServingClient:
             detail = ""
             try:
                 detail = json.loads(exc.read().decode("utf-8")).get("error", "")
-            except Exception:
+            except Exception:  # lawcheck: disable=TW005 -- not a swallow: only the optional error-detail parse degrades; ServingError is raised right below either way
                 pass
             raise ServingError(
                 detail or f"predict failed: HTTP {exc.code}", status=exc.code

@@ -45,6 +45,7 @@ import numpy as np
 from ..apps.common import FetchAbort, FetchPipeline
 from ..telemetry import metrics as _metrics
 from ..utils import get_logger
+from ..utils.clock import now_ms
 from .engine import PredictEngine
 
 log = get_logger("serving.plane")
@@ -213,10 +214,11 @@ class ServingPlane:
         or a full standard-API tweet JSON carrying ``retweeted_status`` —
         then the reference's exact object path (``Status.from_json``)
         parses it. ``created_at_ms`` defaults to NOW (age feature 0) for
-        queries about fresh tweets."""
+        queries about fresh tweets — read through the TWTML_NOW_MS seam so
+        pinned replays see pinned ages (utils/clock)."""
         from ..features.featurizer import Status
 
-        now_ms = int(time.time() * 1000)
+        default_created_ms = now_ms()
         out = []
         for row in rows:
             if isinstance(row, str):
@@ -233,7 +235,7 @@ class ServingPlane:
                     favourites_count=int(row.get("favourites_count") or 0),
                     friends_count=int(row.get("friends_count") or 0),
                     created_at_ms=int(
-                        row.get("created_at_ms") or now_ms
+                        row.get("created_at_ms") or default_created_ms
                     ),
                     lang=str(row.get("lang") or ""),
                 )
@@ -295,13 +297,13 @@ class ServingPlane:
         from ..features.featurizer import Status
 
         warm = Status(text="warmup", retweeted_status=Status(
-            text="warmup", created_at_ms=int(time.time() * 1000),
+            text="warmup", created_at_ms=now_ms(),
         ))
         batch = self._featurize([warm])
         wire = self._engine.pack_for_wire(batch) if (
             self._engine.accepts_packed
         ) else batch
-        jax.device_get(self._engine.step(wire))
+        jax.device_get(self._engine.step(wire))  # lawcheck: disable=TW002 -- one-off pre-traffic compile warmup on the serve-loop thread, before the FetchPipeline takes over; never on a per-request path
 
     # -- the serve loop -------------------------------------------------------
     def _featurize(self, statuses):

@@ -33,6 +33,7 @@ from ..telemetry import metrics as _metrics
 from ..telemetry import sideband as _sideband
 from ..telemetry import trace as _trace
 from ..utils import get_logger
+from ..utils.clock import now_s
 from .sources import Source
 
 log = get_logger("streaming.context")
@@ -69,7 +70,7 @@ def _watched_allgather(arr, timeout_s: float):
     def run() -> None:
         try:
             box["out"] = multihost_utils.process_allgather(arr)
-        except BaseException as exc:  # noqa: BLE001 — re-raised below
+        except BaseException as exc:  # noqa: BLE001 — re-raised below  # lawcheck: disable=TW005 -- not a swallow: captured into the box and re-raised by the waiting caller
             box["exc"] = exc
         done.set()
 
@@ -563,7 +564,7 @@ class StreamingContext:
                 # the producer — the run_to_completion contract
                 self._stop.wait(0.002)
                 continue
-            self._run_batch(self._drain(limit), time.time())
+            self._run_batch(self._drain(limit), now_s())
             if self._source.exhausted and self._queue.empty():
                 break
         self._terminated.set()
@@ -836,7 +837,7 @@ class StreamingContext:
                 # somebody has rows: EVERY host dispatches (local may be
                 # empty — it pads to the pinned bucket)
                 try:
-                    self._run_batch_aligned(local, time.time())
+                    self._run_batch_aligned(local, now_s())
                 except Exception:
                     log.critical(
                         "lockstep batch failed after featurize; this host's "
@@ -894,7 +895,7 @@ class StreamingContext:
             try:
                 pending.append(self._queue.get(timeout=0.05))
                 if len(pending) >= max_batch_size:
-                    self._run_batch(pending, time.time())
+                    self._run_batch(pending, now_s())
                     pending = []
             except queue.Empty:
                 if self._source.exhausted:
@@ -903,6 +904,6 @@ class StreamingContext:
                     pending.extend(self._drain())
                     break
         if pending and not self._stop.is_set():
-            self._run_batch(pending, time.time())
+            self._run_batch(pending, now_s())
         self._terminated.set()
         return self.batches_processed - n0

@@ -25,7 +25,7 @@ def backends_initialized() -> bool | None:
         from jax._src import xla_bridge
 
         return bool(xla_bridge.backends_are_initialized())
-    except Exception:
+    except Exception:  # lawcheck: disable=TW005 -- documented probe contract: None means 'jax-internal symbol unavailable', callers fall back to public-API behavior (docstring above)
         return None
 
 

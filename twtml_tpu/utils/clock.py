@@ -1,0 +1,35 @@
+"""The wall-clock seam: one place where TWTML_NOW_MS pins time.
+
+PR 4's sentinel acceptance test and the serving parity tests work by
+bit-replaying runs, which only holds if every clock that feeds features or
+batch identity is pinnable. The featurizer reads ``TWTML_NOW_MS`` at
+construction (features/featurizer.py); lockstep, sentinel, and serving
+code must read the SAME seam instead of ``time.time()`` directly — the
+lawcheck rule TW006 enforces that statically.
+
+``time.monotonic()`` is unaffected: pure intervals (deadlines, rate
+windows, backoff) should stay monotonic and are not part of replay
+identity.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def now_ms() -> int:
+    """Epoch milliseconds, pinned by TWTML_NOW_MS when set (the
+    deterministic-replay seam shared with Featurizer.from_conf)."""
+    env = os.environ.get("TWTML_NOW_MS", "")
+    if env:
+        # a malformed pin raises, like featurizer.from_conf on the same
+        # value — silently falling back to the wall clock would un-pin a
+        # replay that believes itself pinned
+        return int(env)
+    return int(time.time() * 1000)
+
+
+def now_s() -> float:
+    """Epoch seconds through the same seam (lockstep batch timestamps)."""
+    return now_ms() / 1000.0

@@ -74,7 +74,7 @@ class RssWatchdog:
             from ..telemetry import metrics as _metrics
 
             _metrics.get_registry().gauge("host.rss_mb").set(round(cur, 1))
-        except Exception:
+        except Exception:  # lawcheck: disable=TW005 -- telemetry side-channel publish: the RSS gauge must never kill the recycle watchdog (Try-parity)
             pass
         if self._base is None:
             self._base = cur

@@ -117,5 +117,5 @@ class ApiCache:
         try:
             with open(self.backup_file, encoding="utf-8") as fh:
                 self.cache(fh.read())
-        except Exception:
-            pass  # best-effort, like the Try at ApiCache.scala:50-52
+        except Exception:  # lawcheck: disable=TW005 -- reference Try parity: best-effort restore, ApiCache.scala:50-52
+            pass

@@ -249,7 +249,7 @@ class Server:
         for ws in list(self._websockets):
             try:
                 await ws.close()
-            except Exception:
+            except Exception:  # lawcheck: disable=TW005 -- best-effort websocket close on shutdown; a dead client must not wedge server stop
                 pass
         if self._runner is not None:
             await self._runner.cleanup()
@@ -275,7 +275,7 @@ class Server:
         fut = asyncio.run_coroutine_threadsafe(self._stop_async(), self._loop)
         try:
             fut.result(timeout=5)
-        except Exception:
+        except Exception:  # lawcheck: disable=TW005 -- best-effort bounded shutdown: a wedged event loop is abandoned (daemon thread) rather than hanging the app exit
             pass
         self._loop.call_soon_threadsafe(self._loop.stop)
         if self._thread is not None:
