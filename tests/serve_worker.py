@@ -1,0 +1,27 @@
+"""Replica launcher for the fleet integration tests: configures a 1-device
+CPU jax runtime, then drives the REAL serve entry point with its own CLI —
+one ``apps/serve.py`` replica process of a read fleet
+(``--checkpointDir`` shared with the trainer and the other replicas).
+
+Not a test module — spawned by tests/test_fleet.py.
+
+Usage: python tests/serve_worker.py [serve args...]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+from twtml_tpu.utils.backend import set_cpu_device_count_hint  # noqa: E402
+
+set_cpu_device_count_hint(1)
+
+from twtml_tpu.apps import serve  # noqa: E402
+
+serve.main(list(sys.argv[1:]))

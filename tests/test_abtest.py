@@ -1,0 +1,421 @@
+"""Champion/challenger serving (ISSUE 11): promotion rule, mirror parity,
+atomic champion swap, shadow scoring, and the --abtest entry-point face.
+
+The laws under test:
+
+- **mirror parity**: with champion c, every live prediction BIT-equals what
+  tenant c's standalone single model would produce for the same batch (the
+  PR 9 read-path parity law applied per variant, all rows answered by one
+  tenant — never mixed);
+- **one gate**: auto-promotion goes through ``serving.snapshot
+  .is_promotable`` — an alert-stamped challenger with the best online loss
+  is REFUSED and counted, and promotion fires exactly once per stamped
+  step;
+- **zero added fetches**: challengers ride the champion's coalesced batch
+  through the one mirrored program — one ``device_get`` per predict batch,
+  shadow scores included.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from twtml_tpu.config import ConfArguments  # noqa: E402
+from twtml_tpu.features.featurizer import Featurizer  # noqa: E402
+from twtml_tpu.models import (  # noqa: E402
+    StreamingLinearRegressionWithSGD,
+)
+from twtml_tpu.serving.abtest import (  # noqa: E402
+    ChampionEngine,
+    ChampionSelector,
+)
+from twtml_tpu.serving.plane import ServingPlane  # noqa: E402
+from twtml_tpu.serving.snapshot import ServingSnapshot  # noqa: E402
+from twtml_tpu.streaming.sources import SyntheticSource  # noqa: E402
+from twtml_tpu.telemetry import metrics as _metrics  # noqa: E402
+
+NOW_MS = 1785320000000
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    _metrics.reset_for_tests()
+    yield
+    _metrics.reset_for_tests()
+
+
+def _statuses(n, seed=3):
+    return list(SyntheticSource(total=n, seed=seed).produce())
+
+
+def _feat():
+    return Featurizer(now_ms=NOW_MS)
+
+
+def _stamps(entries):
+    """meta with per-tenant quality stamps; entries = [(level, loss), ...]"""
+    return {"quality": {"level": "ok", "tenants": [
+        {"tenant": i, "level": level, "loss": loss,
+         "drift_score": 9.0 if level == "alert" else 0.5,
+         "loss_trend": 0.0}
+        for i, (level, loss) in enumerate(entries)
+    ]}}
+
+
+def _stack(m, seed=0, scale=1e-3):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((m, 1004)) * scale).astype(np.float32)
+
+
+def _plane(snapshot, engine, **kw):
+    kw.setdefault("featurizer", _feat())
+    kw.setdefault("batch_rows", 32)
+    kw.setdefault("max_wait_ms", 5.0)
+    kw.setdefault("depth", 4)
+    return ServingPlane(snapshot, engine=engine, **kw)
+
+
+def _refs_per_tenant(stack, statuses, row_bucket=32):
+    """tenant -> the standalone single model's masked predictions."""
+    import jax
+
+    batch = _feat().featurize_batch_ragged(
+        statuses, row_bucket=row_bucket, pre_filtered=True
+    )
+    mask = np.asarray(batch.mask) > 0
+    refs = {}
+    for m in range(stack.shape[0]):
+        model = StreamingLinearRegressionWithSGD().set_initial_weights(
+            stack[m]
+        )
+        refs[m] = np.asarray(
+            jax.device_get(model.step(batch)).predictions
+        )[mask]
+    return refs
+
+
+# ---------------------------------------------------------------------------
+# the promotion rule (pure host logic, no jax)
+
+def test_selector_promotes_strictly_better_exactly_once():
+    sel = ChampionSelector(3, champion=0)
+    meta = _stamps([("ok", 10.0), ("ok", 5.0), ("ok", 7.0)])
+    assert sel.consider(meta, step=1) == 1  # best loss wins
+    assert sel.champion == 1
+    assert _metrics.get_registry().counter(
+        "abtest.promotions").snapshot() == 1
+    # the same stamped step never fires twice
+    assert sel.consider(meta, step=1) is None
+    # a step where the champion is already best: no swap
+    assert sel.consider(
+        _stamps([("ok", 10.0), ("ok", 5.0), ("ok", 7.0)]), step=2
+    ) is None
+    assert _metrics.get_registry().counter(
+        "abtest.promotions").snapshot() == 1
+
+
+def test_selector_refuses_alert_challenger_through_the_gate():
+    """An alert-stamped challenger with the BEST online loss must be
+    refused by is_promotable (the one gate) and counted — not silently
+    out-ordered; a healthy runner-up still promotes."""
+    sel = ChampionSelector(3, champion=0)
+    meta = _stamps([("ok", 10.0), ("ok", 5.0), ("alert", 1.0)])
+    assert sel.consider(meta, step=4) == 1  # alert refused; ok runner-up
+    assert sel.champion == 1
+    reg = _metrics.get_registry()
+    assert reg.counter("abtest.promotions_refused").snapshot() == 1
+    assert reg.counter("abtest.promotions").snapshot() == 1
+
+    # alert-only challenger: refused, champion HOLDS
+    sel2 = ChampionSelector(2, champion=0)
+    meta2 = _stamps([("ok", 10.0), ("alert", 1.0)])
+    assert sel2.consider(meta2, step=1) is None
+    assert sel2.champion == 0
+    assert reg.counter("abtest.promotions_refused").snapshot() == 2
+
+
+def test_selector_warn_serves_and_missing_stamps_never_promote():
+    sel = ChampionSelector(2, champion=0)
+    # warn is a servable level (the PR 8 ladder): it may promote
+    assert sel.consider(
+        _stamps([("ok", 10.0), ("warn", 2.0)]), step=1
+    ) == 1
+    # no per-tenant stamps at all: nothing to compare
+    sel2 = ChampionSelector(2, champion=0)
+    assert sel2.consider({"quality": {"level": "ok"}}, step=1) is None
+    assert sel2.consider(None, step=2) is None
+    # a challenger without a loss value scores worst: no evidence never
+    # promotes
+    sel3 = ChampionSelector(2, champion=0)
+    meta = {"quality": {"tenants": [
+        {"tenant": 0, "level": "ok", "loss": 3.0},
+        {"tenant": 1, "level": "ok"},
+    ]}}
+    assert sel3.consider(meta, step=1) is None
+
+
+# ---------------------------------------------------------------------------
+# mirror parity + zero added fetches
+
+def test_champion_answers_bit_equal_and_one_fetch_per_batch():
+    import jax
+
+    stack = _stack(3, seed=7)
+    snap = ServingSnapshot(
+        step=1, weights=stack,
+        meta=_stamps([("ok", 1.0), ("ok", 5.0), ("ok", 9.0)]),
+    )
+    engine = ChampionEngine(num_text_features=1000, num_tenants=3)
+    plane = _plane(snap, engine)
+    statuses = _statuses(24, seed=5)
+    refs = _refs_per_tenant(stack, statuses)
+
+    calls = {"n": 0}
+    real_get = jax.device_get
+
+    def counting(x):
+        calls["n"] += 1
+        return real_get(x)
+
+    jax.device_get = counting
+    try:
+        plane.start()
+        res = plane.submit(statuses).result(timeout=240)
+    finally:
+        jax.device_get = real_get
+        plane.stop()
+    assert engine.champion == 0
+    got = np.asarray(res["predictions"], np.float32)
+    # THE parity law per variant: all 24 rows are EXACTLY tenant 0's
+    # standalone predictions — the mirror answered with one tenant
+    assert np.array_equal(refs[0], got)
+    # challengers rode the same dispatch: ONE fetch for the whole batch,
+    # shadow scores included
+    assert calls["n"] == 1
+
+    view = plane.stats()
+    assert view["champion"] == 0
+    shadows = {s["tenant"]: s for s in view["shadows"]}
+    assert shadows[0]["live"] and shadows[0]["liveRows"] == 24
+    assert not shadows[1]["live"] and shadows[1]["shadowRows"] == 24
+    assert shadows[2]["shadowRows"] == 24
+    # live rows land on the champion tile only
+    rows = {t["tenant"]: t["rows"] for t in view["tenants"]}
+    assert rows == {0: 24, 1: 0, 2: 0}
+
+
+def test_shadow_divergence_tracks_disagreeing_challenger():
+    stack = _stack(2, seed=3, scale=0.5)  # big weights: predictions differ
+    snap = ServingSnapshot(
+        step=1, weights=stack, meta=_stamps([("ok", 1.0), ("ok", 2.0)]),
+    )
+    engine = ChampionEngine(num_text_features=1000, num_tenants=2)
+    plane = _plane(snap, engine).start()
+    try:
+        plane.submit(_statuses(24, seed=9)).result(timeout=240)
+        view = plane.stats()
+    finally:
+        plane.stop()
+    shadow = [s for s in view["shadows"] if s["tenant"] == 1][0]
+    assert shadow["shadowRows"] == 24
+    assert shadow["divergence"] > 0.0
+
+
+# ---------------------------------------------------------------------------
+# the champion-swap differential (the ISSUE 11 satellite)
+
+def test_champion_swap_is_atomic_and_fires_once_under_load():
+    """Differential: a new snapshot whose stamps favor the challenger flips
+    the champion pointer EXACTLY once; under concurrent load every response
+    bit-matches ONE tenant of its claimed snapshot (never a mixed batch),
+    and an alert-stamped best-loss challenger is refused and counted."""
+    stack = _stack(3, seed=11, scale=0.05)
+    statuses = _statuses(8, seed=21)
+    refs = _refs_per_tenant(stack, statuses)
+
+    # step 1: champion 0 best; the alert tenant 2 has the best loss and
+    # must be refused through is_promotable (counted below)
+    snap1 = ServingSnapshot(
+        step=1, weights=stack,
+        meta=_stamps([("ok", 1.0), ("ok", 5.0), ("alert", 0.1)]),
+    )
+    engine = ChampionEngine(num_text_features=1000, num_tenants=3)
+    plane = _plane(snap1, engine, max_wait_ms=0.5).start()
+    plane.warmup()
+    assert engine.champion == 0
+
+    results = []
+    errors = []
+
+    def loader():
+        try:
+            for _ in range(10):
+                results.append(
+                    plane.submit(list(statuses)).result(timeout=120)
+                )
+        except Exception as exc:  # pragma: no cover - failure evidence
+            errors.append(exc)
+
+    threads = [threading.Thread(target=loader) for _ in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        # step 2: challenger 1 now strictly better; tenant 2 still alert
+        plane.hot_swap(ServingSnapshot(
+            step=2, weights=stack,
+            meta=_stamps([("ok", 1.0), ("ok", 0.5), ("alert", 0.1)]),
+        ))
+        for t in threads:
+            t.join(timeout=180)
+    finally:
+        plane.stop()
+    assert not errors
+    assert len(results) == 30
+    assert engine.champion == 1  # the pointer flipped...
+    reg = _metrics.get_registry()
+    assert reg.counter("abtest.promotions").snapshot() == 1  # ...once
+    # the alert challenger was refused at BOTH stamped steps, via the gate
+    assert reg.counter("abtest.promotions_refused").snapshot() == 2
+
+    champion_by_step = {1: 0, 2: 1}
+    seen_steps = set()
+    for res in results:
+        step = res["snapshot_step"]
+        seen_steps.add(step)
+        champ = champion_by_step[step]
+        # dispatch-time (snapshot, champion) ride together: the response
+        # must be EXACTLY that tenant's vector — a torn swap would match
+        # neither, a mixed batch would match no single tenant
+        assert np.array_equal(
+            refs[champ], np.asarray(res["predictions"], np.float32)
+        ), f"response torn across tenants (claimed step {step})"
+    assert 2 in seen_steps  # the promoted champion actually served traffic
+
+
+# ---------------------------------------------------------------------------
+# the --abtest entry-point face
+
+def _save_stacked_ckpt(directory, step, weights, entries):
+    from twtml_tpu.checkpoint import Checkpointer
+
+    meta = {"count": step * 10, "batches": step}
+    meta["quality"] = _stamps(entries)["quality"]
+    return Checkpointer(str(directory)).save(
+        step, np.asarray(weights, np.float32), meta
+    )
+
+
+def test_serve_app_abtest_end_to_end(tmp_path, monkeypatch):
+    """Boot apps.serve --abtest on over a stamped tenant-stack checkpoint:
+    the champion answers over real HTTP, the Serving view carries the
+    champion + shadow tiles, and a single-model checkpoint is refused."""
+    import jax
+
+    monkeypatch.setenv("TWTML_NOW_MS", str(NOW_MS))
+    from twtml_tpu.apps import serve as serve_app
+    from twtml_tpu.serving.client import ServingClient
+
+    stack = _stack(2, seed=2)
+    ck = tmp_path / "ck"
+    _save_stacked_ckpt(ck, 3, stack, [("ok", 5.0), ("ok", 9.0)])
+
+    stop = threading.Event()
+    ready = {}
+    ready_evt = threading.Event()
+
+    def started(server, plane, promoter):
+        ready["port"] = server._runner.addresses[0][1]
+        ready_evt.set()
+
+    conf = ConfArguments().parse([
+        "--backend", "cpu", "--master", "local[1]",
+        "--checkpointDir", str(ck), "--servePort", "0",
+        "--serveBatchRows", "32", "--serveMaxWaitMs", "2",
+        "--servePromoteEvery", "600", "--abtest", "on",
+    ])
+    result = {}
+
+    def runner():
+        result["stats"] = serve_app.run(conf, started=started,
+                                        stop_event=stop)
+
+    thread = threading.Thread(target=runner)
+    thread.start()
+    try:
+        assert ready_evt.wait(timeout=300), "serve app never came up"
+        statuses = _statuses(6, seed=2)
+        rows = [{
+            "text": s.retweeted_status.text,
+            "followers_count": s.retweeted_status.followers_count,
+            "favourites_count": s.retweeted_status.favourites_count,
+            "friends_count": s.retweeted_status.friends_count,
+            "created_at_ms": s.retweeted_status.created_at_ms,
+        } for s in statuses]
+        res = ServingClient(f"http://127.0.0.1:{ready['port']}").predict(rows)
+        assert res["snapshotStep"] == 3 and res["servedRows"] == 6
+    finally:
+        stop.set()
+        thread.join(timeout=120)
+    assert not thread.is_alive()
+    assert result["stats"]["champion"] == 0
+    assert [s["tenant"] for s in result["stats"]["shadows"]] == [0, 1]
+
+    # parity through the full HTTP + JSON + mirrored-plane stack
+    batch = _feat().featurize_batch_ragged(
+        statuses, row_bucket=32, pre_filtered=True
+    )
+    ref_model = StreamingLinearRegressionWithSGD().set_initial_weights(
+        stack[0]
+    )
+    ref = np.asarray(jax.device_get(ref_model.step(batch)).predictions)[
+        np.asarray(batch.mask) > 0
+    ]
+    assert np.array_equal(ref, np.asarray(res["predictions"], np.float32))
+
+
+def test_serve_app_abtest_refuses_single_model_checkpoint(tmp_path):
+    from twtml_tpu.apps import serve as serve_app
+    from twtml_tpu.checkpoint import Checkpointer
+
+    ck = tmp_path / "ck"
+    Checkpointer(str(ck)).save(
+        1, np.zeros(1004, np.float32), {"count": 1, "batches": 1}
+    )
+    conf = ConfArguments().parse([
+        "--backend", "cpu", "--checkpointDir", str(ck), "--abtest", "on",
+    ])
+    with pytest.raises(SystemExit, match="tenant-stack"):
+        serve_app.run(conf)
+
+
+def test_per_tenant_quality_stamps_ride_the_checkpoint_meta():
+    """The trainer-side half of the A/B loop: the modelwatch checkpoint
+    stamp grows per-tenant entries (level/drift/trend/loss) on the tenant
+    plane — the online score the promotion rule compares."""
+    from twtml_tpu.telemetry import modelwatch
+
+    modelwatch.reset_for_tests()
+    try:
+        from twtml_tpu.ops.quality import QUALITY_WIDTH
+
+        q = np.zeros((2, QUALITY_WIDTH), np.float64)
+        modelwatch.record_tick(q, np.array([8.0, 8.0]), np.array([4.0, 2.0]))
+        stamp = modelwatch.snapshot_for_checkpoint()
+        assert stamp is not None and len(stamp["tenants"]) == 2
+        t1 = stamp["tenants"][1]
+        assert t1["tenant"] == 1 and t1["level"] == "ok"
+        assert t1["loss"] == pytest.approx(2.0)
+        # the stamp is what the selector consumes end to end
+        sel = ChampionSelector(2, champion=0)
+        assert sel.consider({"quality": stamp}, step=1) == 1
+    finally:
+        modelwatch.reset_for_tests()

@@ -66,6 +66,10 @@ def dashboard(defer_series=False):
         "p50Ms": 0.0, "p95Ms": 0.0, "p99Ms": 0.0, "snapshotStep": -1,
         "level": "", "requests": 0, "rows": 0, "errors": 0, "tenants": [],
     }
+    h.fetch_routes["/api/fleet"] = {
+        "jsonClass": "Fleet", "policy": "", "replicas": [], "requests": 0,
+        "retries": 0, "ejections": 0, "champion": -1,
+    }
     series = h.defer("/api/series") if defer_series else None
     if not defer_series:
         h.fetch_routes["/api/series"] = []
@@ -388,6 +392,7 @@ def test_metrics_backfill_fetched_on_boot():
     assert "/api/tenants" in urls
     assert "/api/model" in urls
     assert "/api/serving" in urls
+    assert "/api/fleet" in urls
 
 
 # ---------------------------------------------------------------------------
@@ -446,6 +451,55 @@ def test_serving_empty_view_is_placeholder():
     assert h.el("serveSnapshot").text == "—"
     assert h.el("serveLevel").text == "—"
     assert h.el("servingTenantsPanel").children == []
+
+
+# ---------------------------------------------------------------------------
+# read-fleet tiles (ISSUE 11, mirrors the Serving suite)
+
+def test_fleet_frame_updates_tiles_and_replica_row():
+    """Fleet tiles: policy/requests/retries/ejections/champion numbers and
+    one tile per replica, an ejected replica highlighted."""
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="Fleet", policy="p99", requests=1234, retries=3,
+        ejections=1, champion=2, replicas=[
+            {"replica": 0, "url": "http://r0:8888", "healthy": True,
+             "p99Ms": 84.4, "qps": 52.61, "requests": 700, "errors": 0,
+             "ejections": 0, "snapshotStep": 640},
+            {"replica": 1, "url": "http://r1:8888", "healthy": False,
+             "p99Ms": 0.0, "qps": 0.0, "requests": 534, "errors": 4,
+             "ejections": 1, "snapshotStep": 640},
+        ],
+    ))
+    assert h.el("fleetPolicy").text == "p99"
+    assert h.el("fleetRequests").text == "1,234"
+    assert h.el("fleetRetries").text == "3"
+    assert "degraded" in h.el("fleetRetries").class_set
+    assert h.el("fleetEjections").text == "1"
+    assert "degraded" in h.el("fleetEjections").class_set
+    assert h.el("fleetChampion").text == "tenant 2"
+    tiles = h.el("fleetPanel").children
+    assert len(tiles) == 2
+    assert tiles[0].children[0].text == "replica 0"
+    assert tiles[0].children[1].text == "52.6 qps · p99 84 ms"
+    assert "ejected" not in tiles[0].class_set
+    assert tiles[1].children[0].text == "replica 1 · ejected"
+    assert "ejected" in tiles[1].class_set
+
+
+def test_fleet_empty_view_is_placeholder():
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="Fleet", policy="", replicas=[], requests=0, retries=0,
+        ejections=0, champion=-1,
+    ))
+    assert h.el("fleetPolicy").text == "—"
+    assert h.el("fleetChampion").text == "—"
+    assert h.el("fleetRetries").text == "0"
+    assert "degraded" not in h.el("fleetRetries").class_set
+    assert h.el("fleetPanel").children == []
 
 
 def test_unknown_jsonclass_is_ignored():

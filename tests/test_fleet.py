@@ -1,0 +1,611 @@
+"""Read fleet (ISSUE 11): router policies, ejection + jittered re-probe,
+client retry, the multi-process tear invariant ACROSS replicas, SIGKILL →
+zero client-visible errors, and the zero-train-fetch acceptance with a
+fleet + shadow challengers live.
+
+The fleet laws under test:
+
+- **tear invariant across replicas**: while a trainer publishes new
+  promotable snapshots mid-load, EVERY response routed through the fleet
+  bit-matches the snapshot step it claims — replicas promote independently
+  but each response names (and matches) exactly one stamped step;
+- **failure is drained, not surfaced**: a SIGKILLed replica is ejected
+  behind a jittered backoff and its traffic retried on the others — zero
+  client-visible errors;
+- **the read fleet is a side-channel**: with a router, a replica plane, a
+  promoter, and shadow challengers all live against the trainer's
+  checkpoint directory, the train path still fetches exactly once per
+  batch and produces bit-identical weights to a no-fleet control.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from twtml_tpu.config import ConfArguments  # noqa: E402
+from twtml_tpu.features.featurizer import Featurizer  # noqa: E402
+from twtml_tpu.models import (  # noqa: E402
+    StreamingLinearRegressionWithSGD,
+)
+from twtml_tpu.serving.client import ServingClient, ServingError  # noqa: E402
+from twtml_tpu.serving.fleet import FleetRouter  # noqa: E402
+from twtml_tpu.serving.plane import ServingPlane  # noqa: E402
+from twtml_tpu.serving.snapshot import (  # noqa: E402
+    ServingSnapshot,
+    SnapshotPromoter,
+    load_servable,
+)
+from twtml_tpu.streaming.sources import SyntheticSource  # noqa: E402
+from twtml_tpu.telemetry import metrics as _metrics  # noqa: E402
+from twtml_tpu.web.cache import ApiCache  # noqa: E402
+from twtml_tpu.web.server import Server  # noqa: E402
+
+NOW_MS = 1785320000000
+CLOSED = "http://127.0.0.1:9"  # closed port: telemetry best-effort no-ops
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    _metrics.reset_for_tests()
+    yield
+    _metrics.reset_for_tests()
+
+
+def _statuses(n, seed=3):
+    return list(SyntheticSource(total=n, seed=seed).produce())
+
+
+def _feat():
+    return Featurizer(now_ms=NOW_MS)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _save_ckpt(directory, step, weights, level="ok"):
+    from twtml_tpu.checkpoint import Checkpointer
+
+    meta = {"count": step * 10, "batches": step,
+            "quality": {"level": level, "drift_score": 0.5,
+                        "loss_trend": 0.0}}
+    return Checkpointer(str(directory)).save(
+        step, np.asarray(weights, np.float32), meta
+    )
+
+
+def _weights_for_step(step):
+    """Deterministic per-step weights, recomputable in any process."""
+    rng = np.random.default_rng(100 + step)
+    return (rng.standard_normal(1004) * 1e-2).astype(np.float32)
+
+
+def _refs_for_steps(steps, statuses, row_bucket=32):
+    import jax
+
+    batch = _feat().featurize_batch_ragged(
+        statuses, row_bucket=row_bucket, pre_filtered=True
+    )
+    mask = np.asarray(batch.mask) > 0
+    refs = {}
+    for step in steps:
+        model = StreamingLinearRegressionWithSGD().set_initial_weights(
+            _weights_for_step(step)
+        )
+        refs[step] = np.asarray(
+            jax.device_get(model.step(batch)).predictions
+        )[mask]
+    return refs
+
+
+def _replica(tmp_path, name, snapshot, **plane_kw):
+    """One in-process replica: plane + real HTTP server; returns
+    (url, plane, server)."""
+    plane_kw.setdefault("featurizer", _feat())
+    plane_kw.setdefault("batch_rows", 32)
+    plane_kw.setdefault("max_wait_ms", 2.0)
+    plane_kw.setdefault("depth", 4)
+    plane = ServingPlane(snapshot, **plane_kw).start()
+    server = Server(
+        port=0, host="127.0.0.1",
+        cache=ApiCache(backup_file=str(tmp_path / f"{name}.json")),
+    ).attach_serving(plane)
+    server.start_background()
+    url = f"http://127.0.0.1:{server._runner.addresses[0][1]}"
+    return url, plane, server
+
+
+def _rows_for(statuses):
+    return [{
+        "text": s.retweeted_status.text,
+        "followers_count": s.retweeted_status.followers_count,
+        "favourites_count": s.retweeted_status.favourites_count,
+        "friends_count": s.retweeted_status.friends_count,
+        "created_at_ms": s.retweeted_status.created_at_ms,
+        "retweet_count": s.retweeted_status.retweet_count,
+    } for s in statuses]
+
+
+# ---------------------------------------------------------------------------
+# router core: policies, ejection, retries (in-process replicas, real HTTP)
+
+def test_router_smoke_single_replica(tmp_path):
+    """The CI fleet smoke: a real router process loop (apps.router.run)
+    over one replica — one predict roundtrip through the front door, a
+    live /api/fleet view, clean shutdown."""
+    from twtml_tpu.apps import router as router_app
+
+    snap = ServingSnapshot(step=1, weights=_weights_for_step(1),
+                           meta={"quality": {"level": "ok"}})
+    url, plane, server = _replica(tmp_path, "r0", snap)
+    stop = threading.Event()
+    ready = {}
+    ready_evt = threading.Event()
+
+    def started(srv, rt):
+        ready["port"] = srv._runner.addresses[0][1]
+        ready_evt.set()
+
+    conf = ConfArguments().parse([
+        "--replicas", url, "--routerPort", "0", "--routePolicy", "p99",
+    ])
+    result = {}
+
+    def runner():
+        result["stats"] = router_app.run(conf, started=started,
+                                         stop_event=stop)
+
+    thread = threading.Thread(target=runner)
+    thread.start()
+    try:
+        assert ready_evt.wait(timeout=60), "router never came up"
+        client = ServingClient(f"http://127.0.0.1:{ready['port']}")
+        statuses = _statuses(6, seed=2)
+        res = client.predict(_rows_for(statuses))
+        assert res["snapshotStep"] == 1 and res["servedRows"] == 6
+        view = client.fleet()
+        assert view["jsonClass"] == "Fleet" and view["policy"] == "p99"
+        assert len(view["replicas"]) == 1
+        assert view["replicas"][0]["healthy"]
+        assert view["requests"] >= 1 and view["ejections"] == 0
+    finally:
+        stop.set()
+        thread.join(timeout=60)
+        server.stop()
+        plane.stop()
+    assert not thread.is_alive()
+    assert result["stats"]["requests"] >= 1
+
+
+def test_route_policy_p99_spreads_and_hash_sticks(tmp_path):
+    snap = ServingSnapshot(step=1, weights=_weights_for_step(1))
+    url_a, plane_a, srv_a = _replica(tmp_path, "a", snap)
+    url_b, plane_b, srv_b = _replica(
+        tmp_path, "b", ServingSnapshot(step=1, weights=_weights_for_step(1))
+    )
+    body = json.dumps(
+        {"rows": [{"text": "route me", "created_at_ms": NOW_MS}]}
+    ).encode()
+    try:
+        p99 = FleetRouter([url_a, url_b], policy="p99")
+        for _ in range(8):
+            status, _payload = p99.predict(body)
+            assert status == 200
+        counts = [r.requests for r in p99.replicas]
+        assert all(c > 0 for c in counts)  # ties round-robin: both serve
+
+        sticky = FleetRouter([url_a, url_b], policy="hash")
+        for _ in range(6):
+            status, _payload = sticky.predict(body)
+            assert status == 200
+        counts = [r.requests for r in sticky.replicas]
+        # one key -> ONE replica, every time
+        assert sorted(counts) == [0, 6]
+        # many distinct keys spread over the ring
+        for i in range(32):
+            key_body = json.dumps({"rows": [f"key {i}"]}).encode()
+            status, _payload = sticky.predict(key_body)
+            assert status == 200
+        assert all(r.requests > 0 for r in sticky.replicas)
+    finally:
+        for srv, plane in ((srv_a, plane_a), (srv_b, plane_b)):
+            srv.stop()
+            plane.stop()
+
+
+def test_dead_replica_ejects_retries_and_recovers(tmp_path):
+    """A dead replica's forward retries on the live one (counted), ejects
+    the dead one behind a backoff (counted), and a later health probe
+    restores it once it answers again."""
+    snap = ServingSnapshot(step=1, weights=_weights_for_step(1))
+    url_live, plane, srv = _replica(tmp_path, "live", snap)
+    dead_port = _free_port()
+    url_dead = f"http://127.0.0.1:{dead_port}"
+    body = json.dumps({"rows": ["hello fleet"]}).encode()
+    try:
+        router = FleetRouter([url_dead, url_live], policy="p99")
+        ok = 0
+        for _ in range(6):
+            status, payload = router.predict(body)
+            assert status == 200, payload
+            ok += 1
+        assert ok == 6  # the dead replica never surfaced an error
+        reg = _metrics.get_registry()
+        assert reg.counter("router.retries").snapshot() >= 1
+        assert reg.counter("fleet.replica_ejections").snapshot() >= 1
+        view = router.stats()
+        by_url = {r["url"]: r for r in view["replicas"]}
+        assert not by_url[url_dead]["healthy"]
+        assert by_url[url_live]["healthy"]
+        assert view["ejections"] >= 1 and view["retries"] >= 1
+
+        # a replica coming up at the dead address is restored by the probe
+        srv2 = Server(
+            port=dead_port, host="127.0.0.1",
+            cache=ApiCache(backup_file=str(tmp_path / "late.json")),
+        ).attach_serving(plane)
+        srv2.start_background()
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                router.replicas[0].ejected_until = 0.0  # skip the backoff
+                router.health_check_once()
+                if router.replicas[0].healthy:
+                    break
+                time.sleep(0.05)
+            assert router.replicas[0].healthy
+            assert reg.counter("fleet.replica_restores").snapshot() >= 1
+        finally:
+            srv2.stop()
+    finally:
+        srv.stop()
+        plane.stop()
+
+
+def test_all_replicas_down_is_clean_503():
+    router = FleetRouter(
+        [f"http://127.0.0.1:{_free_port()}",
+         f"http://127.0.0.1:{_free_port()}"],
+    )
+    status, payload = router.predict(b'{"rows": ["x"]}')
+    assert status == 503
+    assert "replica" in json.loads(payload.decode())["error"]
+    assert _metrics.get_registry().counter("router.errors").snapshot() == 1
+
+
+def test_bad_request_passes_through_without_ejection(tmp_path):
+    """A 4xx is the request's fault: no retry, no ejection — every replica
+    would agree."""
+    snap = ServingSnapshot(step=1, weights=_weights_for_step(1))
+    url, plane, srv = _replica(tmp_path, "r", snap)
+    try:
+        router = FleetRouter([url])
+        status, payload = router.predict(b'{"rows": 7}')
+        assert status == 400
+        assert "bad predict request" in json.loads(payload.decode())["error"]
+        assert router.replicas[0].healthy
+        reg = _metrics.get_registry()
+        assert reg.counter("router.retries").snapshot() == 0
+        assert reg.counter("fleet.replica_ejections").snapshot() == 0
+    finally:
+        srv.stop()
+        plane.stop()
+
+
+def test_client_jittered_retry_on_503_and_connection_refused():
+    """ServingClient retries 503/connection-refused on the Source._backoff
+    cap+jitter ladder (counted in serve.client_retries); a non-retryable
+    failure raises immediately."""
+    client = ServingClient(f"http://127.0.0.1:{_free_port()}",
+                           timeout=1.0, retries=2)
+    t0 = time.monotonic()
+    with pytest.raises(ServingError):
+        client.predict(["x"])
+    # two jittered sleeps happened: >= 0.5x of (0.1 + 0.2)
+    assert time.monotonic() - t0 >= 0.15
+    assert _metrics.get_registry().counter(
+        "serve.client_retries").snapshot() == 2
+
+    # the ladder: jittered into [0.5x, 1x], capped
+    for attempt, base in ((1, 0.1), (2, 0.2), (3, 0.4), (30, 2.0)):
+        for _ in range(8):
+            b = ServingClient._backoff(attempt)
+            assert 0.5 * base <= b <= base
+
+    # retries=0 keeps the legacy fail-fast face
+    fast = ServingClient(f"http://127.0.0.1:{_free_port()}",
+                         timeout=1.0, retries=0)
+    with pytest.raises(ServingError):
+        fast.predict(["x"])
+    assert _metrics.get_registry().counter(
+        "serve.client_retries").snapshot() == 2  # unchanged
+
+
+# ---------------------------------------------------------------------------
+# multi-process fleet: the tear invariant + SIGKILL ejection
+
+def _spawn_replica(ck, port, tmp_path, name):
+    env = dict(os.environ)
+    env["TWTML_NOW_MS"] = str(NOW_MS)
+    env.pop("XLA_FLAGS", None)  # 1-device replica; the worker pins cpu
+    out = open(tmp_path / f"{name}.log", "w")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tests", "serve_worker.py"),
+         "--checkpointDir", str(ck), "--servePort", str(port),
+         "--serveBatchRows", "32", "--serveMaxWaitMs", "2",
+         "--servePromoteEvery", "0.1", "--backend", "cpu",
+         "--master", "local[1]"],
+        env=env, stdout=out, stderr=subprocess.STDOUT,
+    )
+    return proc, out
+
+
+def _wait_step(url, step, deadline_s=300.0):
+    client = ServingClient(url, timeout=2.0, retries=0)
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            if client.serving().get("snapshotStep", -1) >= step:
+                return True
+        except Exception:
+            pass
+        time.sleep(0.25)
+    return False
+
+
+def test_fleet_two_replica_processes_tear_invariant_and_sigkill(tmp_path):
+    """ACCEPTANCE (ISSUE 11): real router loop + 2 REAL replica processes
+    over HTTP. While the 'trainer' (this test) publishes new promotable
+    snapshots mid-load, every routed response bit-matches its claimed
+    snapshot step; then a SIGKILLed replica is ejected with ZERO
+    client-visible errors."""
+    from twtml_tpu.apps import router as router_app
+
+    ck = tmp_path / "ck"
+    _save_ckpt(ck, 1, _weights_for_step(1))
+    statuses = _statuses(8, seed=21)
+    refs = _refs_for_steps((1, 2, 3), statuses)
+    rows = _rows_for(statuses)
+
+    ports = (_free_port(), _free_port())
+    procs = []
+    logs = []
+    stop = threading.Event()
+    router_thread = None
+    try:
+        for i, port in enumerate(ports):
+            proc, out = _spawn_replica(ck, port, tmp_path, f"replica{i}")
+            procs.append(proc)
+            logs.append(out)
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+        for url in urls:
+            assert _wait_step(url, 1), (
+                f"replica {url} never promoted step 1; see tmp logs"
+            )
+
+        ready = {}
+        ready_evt = threading.Event()
+
+        def started(srv, rt):
+            ready["router"] = rt
+            ready["port"] = srv._runner.addresses[0][1]
+            ready_evt.set()
+
+        conf = ConfArguments().parse([
+            "--replicas", ",".join(urls), "--routerPort", "0",
+        ])
+        router_thread = threading.Thread(
+            target=router_app.run,
+            kwargs=dict(conf=conf, started=started, stop_event=stop),
+        )
+        router_thread.start()
+        assert ready_evt.wait(timeout=60), "router never came up"
+        client = ServingClient(f"http://127.0.0.1:{ready['port']}",
+                               timeout=60.0, retries=2)
+
+        responses = []
+
+        def load(n):
+            for _ in range(n):
+                responses.append(client.predict(rows))
+
+        # phase 1: both replicas on step 1
+        load(6)
+        # trainer publishes step 2 mid-load; replicas promote independently
+        _save_ckpt(ck, 2, _weights_for_step(2))
+        load(4)
+        for url in urls:
+            assert _wait_step(url, 2)
+        load(4)
+        # ...and step 3
+        _save_ckpt(ck, 3, _weights_for_step(3))
+        for url in urls:
+            assert _wait_step(url, 3)
+        load(6)
+
+        # THE tear invariant ACROSS replicas: every response bit-matches
+        # the snapshot step it claims, whichever replica served it and
+        # wherever in the promotion race it landed
+        seen_steps = set()
+        for res in responses:
+            step = res["snapshotStep"]
+            seen_steps.add(step)
+            assert step in refs, f"response claims unknown step {step}"
+            assert np.array_equal(
+                refs[step], np.asarray(res["predictions"], np.float32)
+            ), f"response torn vs its claimed snapshot (step {step})"
+        assert 1 in seen_steps and 3 in seen_steps
+
+        # SIGKILL one replica mid-fleet: traffic must keep flowing with
+        # ZERO client-visible errors (router retries + ejects; the client
+        # ladder covers any residual window)
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait(timeout=30)
+        survivors = []
+        for _ in range(12):
+            res = client.predict(rows)  # raises on any client-visible error
+            survivors.append(res)
+        for res in survivors:
+            assert np.array_equal(
+                refs[3], np.asarray(res["predictions"], np.float32)
+            )
+        view = client.fleet()
+        by_url = {r["url"]: r for r in view["replicas"]}
+        assert not by_url[urls[0]]["healthy"]
+        assert by_url[urls[1]]["healthy"]
+        assert view["ejections"] >= 1
+    finally:
+        stop.set()
+        if router_thread is not None:
+            router_thread.join(timeout=60)
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        for out in logs:
+            out.close()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the fleet is a read-only side-channel of the train path
+
+def _write_replay(tmp_path, n, seed=31):
+    path = tmp_path / "tweets.jsonl"
+    with open(path, "w", encoding="utf-8") as fh:
+        for s in SyntheticSource(total=n, seed=seed, base_ms=NOW_MS).produce():
+            d = {
+                "text": s.text, "retweet_count": s.retweet_count,
+                "user": {"followers_count": s.followers_count,
+                         "favourites_count": s.favourites_count,
+                         "friends_count": s.friends_count},
+                "timestamp_ms": str(s.created_at_ms), "lang": s.lang or "en",
+            }
+            if s.retweeted_status is not None:
+                r = s.retweeted_status
+                d["retweeted_status"] = {
+                    "text": r.text, "retweet_count": r.retweet_count,
+                    "user": {"followers_count": r.followers_count,
+                             "favourites_count": r.favourites_count,
+                             "friends_count": r.friends_count},
+                    "timestamp_ms": str(r.created_at_ms),
+                }
+            fh.write(json.dumps(d) + "\n")
+    return path
+
+
+def test_fleet_and_shadow_challengers_add_zero_train_fetches(
+    tmp_path, monkeypatch
+):
+    """ACCEPTANCE: with a FULL fleet live against the trainer's checkpoint
+    directory — an --abtest (champion + shadow challengers) replica plane,
+    its promoter, a replica HTTP server, and a fleet router — the
+    --tenants 2 train path still fetches exactly once per batch, and the
+    trained champion/challenger stack is bit-identical to a no-fleet
+    control."""
+    import jax
+
+    from twtml_tpu.apps import linear_regression as app
+    from twtml_tpu.checkpoint import Checkpointer
+    from twtml_tpu.serving.abtest import ChampionEngine
+
+    monkeypatch.setenv("TWTML_NOW_MS", str(NOW_MS))
+    path = _write_replay(tmp_path, 8 * 16)
+    base = [
+        "--source", "replay", "--replayFile", str(path),
+        "--seconds", "0", "--backend", "cpu", "--master", "local[1]",
+        "--batchBucket", "16", "--tokenBucket", "64", "--tenants", "2",
+        "--lightning", CLOSED, "--twtweb", CLOSED, "--webTimeout", "0.2",
+    ]
+
+    # control run: no fleet anywhere
+    ck_a = str(tmp_path / "ck_a")
+    app.run(ConfArguments().parse(
+        base + ["--checkpointDir", ck_a, "--checkpointEvery", "2"]
+    ))
+    control_state, control_meta = Checkpointer(ck_a).restore()
+
+    # fleet-live run: abtest replica + promoter + router, all against ck_b
+    ck_b = tmp_path / "ck_b"
+    stack0 = np.zeros((2, 1004), np.float32)
+    from twtml_tpu.checkpoint import Checkpointer as _Ck
+
+    _Ck(str(ck_b)).save(0, stack0, {
+        "count": 0, "batches": 0,
+        "quality": {"level": "ok", "tenants": [
+            {"tenant": 0, "level": "ok", "loss": 5.0},
+            {"tenant": 1, "level": "ok", "loss": 9.0},
+        ]},
+    })
+    snap, _reason = load_servable(str(ck_b))
+    engine = ChampionEngine(num_text_features=1000, num_tenants=2)
+    url, plane, server = _replica(
+        tmp_path, "accept", snap, engine=engine
+    )
+    promoter = SnapshotPromoter(str(ck_b), plane, poll_s=0.05).start()
+    router = FleetRouter([url]).start()
+    router_server = Server(
+        port=0, host="127.0.0.1",
+        cache=ApiCache(backup_file=str(tmp_path / "router.json")),
+    ).attach_fleet(router)
+    router_server.start_background()
+    router_url = f"http://127.0.0.1:{router_server._runner.addresses[0][1]}"
+
+    # prove the fleet serves BEFORE the counting window (a predict is a
+    # legitimate serve-path fetch; the law counts TRAIN-path fetches)
+    res = ServingClient(router_url).predict(["warm the fleet"])
+    assert res["servedRows"] == 1 and res["snapshotStep"] == 0
+
+    calls = {"n": 0}
+    real_get = jax.device_get
+
+    def counting(x):
+        calls["n"] += 1
+        return real_get(x)
+
+    jax.device_get = counting
+    try:
+        totals = app.run(ConfArguments().parse(
+            base + ["--checkpointDir", str(ck_b), "--checkpointEvery", "2"]
+        ))
+    finally:
+        jax.device_get = real_get
+    assert totals["batches"] == 8
+    # ONE stacked fetch per train tick — the whole fleet added none
+    assert calls["n"] == 8
+
+    # the fleet converged on the trainer's newest stamped step
+    deadline = time.monotonic() + 10
+    while plane.snapshot_step != totals["batches"] and (
+        time.monotonic() < deadline
+    ):
+        promoter.poll_once()
+        time.sleep(0.01)
+    assert plane.snapshot_step == totals["batches"]
+
+    promoter.stop()
+    router.stop()
+    router_server.stop()
+    server.stop()
+    plane.stop()
+
+    # bit-identity: the champion/challenger stack trained identically
+    fleet_state, fleet_meta = Checkpointer(str(ck_b)).restore()
+    assert fleet_meta["count"] == control_meta["count"]
+    assert np.array_equal(np.asarray(control_state),
+                          np.asarray(fleet_state))

@@ -69,7 +69,29 @@ def run(conf: ConfArguments, started=None, stop_event=None,
         "initial snapshot: step %d, %d tenant(s) — %s",
         snapshot.step, snapshot.num_tenants, reason,
     )
-    plane = ServingPlane.from_conf(conf, snapshot)
+    engine = None
+    if getattr(conf, "abtest", "off") == "on":
+        # champion/challenger (ISSUE 11): the tenant-stack snapshot's
+        # variants ride ONE mirrored predict program — the champion
+        # answers, challengers shadow-score, and per-tenant quality stamps
+        # auto-promote the champion pointer through the is_promotable gate
+        if snapshot.num_tenants < 2:
+            raise SystemExit(
+                "--abtest on needs a tenant-stack checkpoint "
+                f"({snapshot.num_tenants} tenant(s) found): train with "
+                "--tenants M >= 2 so the snapshot carries M variants"
+            )
+        from ..serving.abtest import ChampionEngine
+
+        import jax.numpy as jnp
+
+        engine = ChampionEngine(
+            num_text_features=conf.numTextFeatures,
+            num_tenants=snapshot.num_tenants,
+            tenant_key=getattr(conf, "tenantKey", "hash"),
+            dtype=jnp.dtype(getattr(conf, "dtype", "float32")),
+        )
+    plane = ServingPlane.from_conf(conf, snapshot, engine=engine)
     log.info("pre-compiling the predict program...")
     plane.warmup()
     plane.start()

@@ -200,6 +200,22 @@ class ConfArguments:
         self.servePromoteEvery: float = float(
             conf.get("servePromoteEvery", "5.0")
         )
+        # read fleet + champion/challenger (r14): N serve replicas behind a
+        # router (twtml_tpu/serving/fleet.py, apps/router.py) and shadow-
+        # scored A/B serving on the tenant stack (serving/abtest.py)
+        self.routerPort: int = int(conf.get("routerPort", "8899"))
+        self.replicas: str = conf.get("replicas", "")
+        self.routePolicy: str = conf.get("routePolicy", "p99")
+        if self.routePolicy not in ("p99", "hash"):
+            raise ValueError(
+                f"routePolicy must be 'p99' or 'hash', got "
+                f"{self.routePolicy!r}"
+            )
+        self.abtest: str = conf.get("abtest", "off")
+        if self.abtest not in ("on", "off"):
+            raise ValueError(
+                f"abtest must be 'on' or 'off', got {self.abtest!r}"
+            )
         # model & data observability plane (r11): in-step quality telemetry
         self.modelWatch: str = conf.get("modelWatch", "on")
         if self.modelWatch not in ("on", "off"):
@@ -429,6 +445,39 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                                                ok/warn; alert refuses — the
                                                tools/model_report.py --gate predicate).
                                                Default: {self.servePromoteEvery}
+  --abtest <on|off>                            Champion/challenger serving
+                                               (apps/serve.py over a --tenants M >= 2
+                                               tenant-stack checkpoint): live predict
+                                               traffic is answered by the CHAMPION tenant
+                                               and mirrored shadow-mode to every
+                                               challenger inside the same one-dispatch
+                                               predict program (zero added fetches);
+                                               challengers are scored by the per-tenant
+                                               quality stamps the trainer writes, and a
+                                               strictly better challenger auto-promotes
+                                               the champion pointer through the same
+                                               is_promotable gate snapshots use (an
+                                               alert-stamped challenger is refused and
+                                               counted). Default: {self.abtest}
+  --routerPort <int>                           Fleet router entry point (apps/router.py):
+                                               port the front-door web server (POST
+                                               /api/predict proxy + GET /api/fleet)
+                                               listens on. Default: {self.routerPort}
+  --replicas <url,url,...>                     Fleet router: comma-separated base URLs of
+                                               the serve replicas to route over (e.g.
+                                               http://host:8888,http://host:8889). Each
+                                               replica is health-checked via its GET
+                                               /api/serving; a failing replica is ejected
+                                               behind a jittered backoff and its traffic
+                                               retried on the others.
+  --routePolicy <p99|hash>                     Fleet routing policy: 'p99' sends each
+                                               request to the healthy replica with the
+                                               lowest rolling forward p99 (ties: fewest
+                                               in-flight); 'hash' consistent-hashes the
+                                               request body onto a vnode ring so a given
+                                               key sticks to one replica and only ~1/N of
+                                               keys move on membership change.
+                                               Default: {self.routePolicy}
   --wirePack <auto|stacked|group>              Superbatch wire layout on the ragged wire:
                                                'group' coalesces the K batches into ONE
                                                contiguous buffer (one put; uint16-delta offsets)
@@ -579,6 +628,18 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                 self.printUsage(1)
         elif flag == "--servePromoteEvery":
             self.servePromoteEvery = float(take())
+        elif flag == "--abtest":
+            self.abtest = take()
+            if self.abtest not in ("on", "off"):
+                self.printUsage(1)
+        elif flag == "--routerPort":
+            self.routerPort = int(take())
+        elif flag == "--replicas":
+            self.replicas = take()
+        elif flag == "--routePolicy":
+            self.routePolicy = take()
+            if self.routePolicy not in ("p99", "hash"):
+                self.printUsage(1)
         elif flag == "--modelWatch":
             self.modelWatch = take()
             if self.modelWatch not in ("on", "off"):

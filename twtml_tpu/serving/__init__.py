@@ -17,17 +17,26 @@ existed fused inside the train step. This package splits it out as a product:
                   result fetches through ``apps/common.FetchPipeline`` (the
                   measured 6.2x-at-depth-8 transport trick, BENCHMARKS r3);
 - ``client``    — the library-level HTTP client (``POST /api/predict``) for
-                  load generation and ops scripts.
+                  load generation and ops scripts;
+- ``fleet``     — the read-fleet router (ISSUE 11): N serve replicas behind
+                  one front door — least-p99/consistent-hash routing,
+                  health checks, ejection behind a jittered backoff;
+- ``abtest``    — champion/challenger on the tenant stack: the champion
+                  answers live traffic, challengers shadow-score the same
+                  mirrored batch, and per-tenant quality stamps
+                  auto-promote through the ONE ``is_promotable`` gate.
 
-Import discipline: ``snapshot`` and ``client`` are jax-free (ops tools —
-``tools/model_report.py --gate`` — must not initialize a backend to answer
-"is this checkpoint servable?"); the engine/plane import jax lazily via
-``__getattr__``.
+Import discipline: ``snapshot``, ``client``, and ``fleet`` are jax-free
+(ops tools — ``tools/model_report.py --gate`` — must not initialize a
+backend to answer "is this checkpoint servable?", and the router process
+holds no model at all); the engine/plane/abtest modules import jax lazily
+via ``__getattr__``.
 """
 
 from __future__ import annotations
 
 from .client import ServingClient
+from .fleet import FleetRouter
 from .snapshot import (
     ServingSnapshot,
     SnapshotPromoter,
@@ -36,6 +45,9 @@ from .snapshot import (
 )
 
 __all__ = [
+    "ChampionEngine",
+    "ChampionSelector",
+    "FleetRouter",
     "ServingClient",
     "ServingPlane",
     "ServingSnapshot",
@@ -44,10 +56,19 @@ __all__ = [
     "load_servable",
 ]
 
+_LAZY = {
+    # lazy: these pull in jax via the model layer
+    "ServingPlane": ("plane", "ServingPlane"),
+    "ChampionEngine": ("abtest", "ChampionEngine"),
+    "ChampionSelector": ("abtest", "ChampionSelector"),
+}
+
 
 def __getattr__(name: str):
-    if name == "ServingPlane":  # lazy: pulls in jax via the model layer
-        from .plane import ServingPlane
+    target = _LAZY.get(name)
+    if target is not None:
+        import importlib
 
-        return ServingPlane
+        module = importlib.import_module(f".{target[0]}", __name__)
+        return getattr(module, target[1])
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -144,7 +144,8 @@ class ServingPlane:
         self._install(snapshot)
 
     @classmethod
-    def from_conf(cls, conf, snapshot, model_cls=None, featurizer=None):
+    def from_conf(cls, conf, snapshot, model_cls=None, featurizer=None,
+                  engine=None):
         import jax.numpy as jnp
 
         return cls(
@@ -157,6 +158,7 @@ class ServingPlane:
             tenant_key=getattr(conf, "tenantKey", "hash"),
             dtype=jnp.dtype(getattr(conf, "dtype", "float32")),
             featurizer=featurizer,
+            engine=engine,
         )
 
     # -- request intake ------------------------------------------------------
@@ -456,7 +458,7 @@ class ServingPlane:
                 {"tenant": m, "rows": int(r)}
                 for m, r in enumerate(self._tenant_rows)
             ]
-        return {
+        view = {
             "qps": round(reqs / window, 2),
             "rowsPerSec": round(rows / window, 1),
             "p50Ms": round(self._latency.percentile(0.50) * 1e3, 2),
@@ -469,3 +471,11 @@ class ServingPlane:
             "errors": int(self._err_count.snapshot()),
             "tenants": tenants,
         }
+        # champion/challenger slice (serving/abtest.py): the live champion
+        # + per-tenant shadow divergence ride the same view, so the router
+        # and the dashboard learn the A/B state from the health check they
+        # already make
+        ab = getattr(self._engine, "abtest_view", None)
+        if ab is not None:
+            view.update(ab())
+        return view

@@ -151,13 +151,39 @@ class Serving:
     rows: int = 0
     errors: int = 0
     tenants: list = field(default_factory=list)
+    # champion/challenger slice (ISSUE 11, serving/abtest.py): -1 /[] on a
+    # plain single-model plane; a fleet router reads the champion from this
+    # view through the health check it already makes
+    champion: int = -1
+    shadows: list = field(default_factory=list)
+    promotions: int = 0
+    refusedPromotions: int = 0
 
     json_class = "Serving"
 
 
+@dataclass
+class Fleet:
+    """Read-fleet view — an ADDITIVE message type (no reference equivalent;
+    the reference is one process end to end). Published by the fleet
+    router (serving/fleet.py ``stats()``): per-replica health/latency/
+    traffic tiles, the routing policy, the router's retry/ejection story,
+    and the fleet-wide champion tenant on the champion/challenger plane.
+    Legacy dashboards ignore it like the other additive types."""
+
+    policy: str = ""
+    replicas: list = field(default_factory=list)
+    requests: int = 0
+    retries: int = 0
+    ejections: int = 0
+    champion: int = -1
+
+    json_class = "Fleet"
+
+
 TYPES = {"Config": Config, "Stats": Stats, "Series": Series,
          "Metrics": Metrics, "Hosts": Hosts, "Tenants": Tenants,
-         "ModelHealth": ModelHealth, "Serving": Serving}
+         "ModelHealth": ModelHealth, "Serving": Serving, "Fleet": Fleet}
 
 
 def encode(obj: Config | Stats) -> str:

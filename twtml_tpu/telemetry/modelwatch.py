@@ -359,7 +359,7 @@ class ModelWatch:
             if self._ticks == 0:
                 return None
             wn, un, gn = self._last_norms
-            return {
+            stamp = {
                 "level": self._level,
                 "drift_score": round(
                     max((t.drift for t in self._tracks), default=0.0), 4
@@ -374,6 +374,26 @@ class ModelWatch:
                 "ticks": self._ticks,
                 "episodes": self._episodes,
             }
+            if len(self._tracks) > 1:
+                # per-tenant stamps (ISSUE 11): the champion/challenger
+                # promotion rule compares variants by the ONLINE score the
+                # trainer already computes — level, drift, trend, and the
+                # fast loss EWMA — so A/B verdicts ride the checkpoint
+                # handoff with zero new surfaces
+                stamp["tenants"] = [
+                    {
+                        "tenant": i,
+                        "level": t.level,
+                        "drift_score": round(t.drift, 4),
+                        "loss_trend": round(t.trend, 4),
+                        "loss": (
+                            round(t.ewma_fast, 4)
+                            if t.ewma_fast is not None else -1.0
+                        ),
+                    }
+                    for i, t in enumerate(self._tracks)
+                ]
+            return stamp
 
 
 # -- process-wide watcher ----------------------------------------------------

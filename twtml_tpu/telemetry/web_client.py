@@ -12,8 +12,8 @@ from __future__ import annotations
 import urllib.request
 
 from .api_types import (
-    Config, Hosts, Metrics, ModelHealth, Series, Serving, Stats, Tenants,
-    decode, encode,
+    Config, Fleet, Hosts, Metrics, ModelHealth, Series, Serving, Stats,
+    Tenants, decode, encode,
 )
 
 DEFAULT_SERVER = "http://localhost:8888"  # WebClient.scala:13
@@ -117,6 +117,12 @@ class WebClient:
         dashboard's Serving tile row (additive message; serving/plane.py)."""
         known = Serving.__dataclass_fields__
         self._post(Serving(**{k: v for k, v in view.items() if k in known}))
+
+    def fleet(self, view: dict) -> None:
+        """Push the read-fleet view (``FleetRouter.stats()``) for the
+        dashboard's fleet tile row (additive message; serving/fleet.py)."""
+        known = Fleet.__dataclass_fields__
+        self._post(Fleet(**{k: v for k, v in view.items() if k in known}))
 
     # -- reads (WebClient.scala:40-46) ---------------------------------------
     def get_config(self) -> Config:

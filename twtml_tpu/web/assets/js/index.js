@@ -169,6 +169,47 @@
     }
   }
 
+  function onFleet(json) {
+    // read-fleet tiles (serving/fleet.py stats view via apps/router.py):
+    // policy + router retry/ejection story, the fleet-wide champion on the
+    // champion/challenger plane, and one tile per replica (qps + forward
+    // p99; an ejected replica is highlighted until its probe recovers it)
+    const replicas = json.replicas || [];
+    document.getElementById("fleetPolicy").textContent =
+      replicas.length ? (json.policy || "—") : "—";
+    document.getElementById("fleetRequests").textContent =
+      Number(json.requests || 0).toLocaleString();
+    const retries = Number(json.retries || 0);
+    const retriesEl = document.getElementById("fleetRetries");
+    retriesEl.textContent = String(retries);
+    retriesEl.classList.toggle("degraded", retries > 0);
+    const ejections = Number(json.ejections || 0);
+    const ejectionsEl = document.getElementById("fleetEjections");
+    ejectionsEl.textContent = String(ejections);
+    ejectionsEl.classList.toggle("degraded", ejections > 0);
+    document.getElementById("fleetChampion").textContent =
+      Number(json.champion) >= 0 ? "tenant " + json.champion : "—";
+    const panel = document.getElementById("fleetPanel");
+    panel.replaceChildren();
+    for (const r of replicas) {
+      const tile = document.createElement("div");
+      tile.className = "stat";
+      if (!r.healthy) tile.classList.add("ejected");
+      const label = document.createElement("div");
+      label.className = "label";
+      label.textContent =
+        "replica " + r.replica + (r.healthy ? "" : " · ejected");
+      const value = document.createElement("div");
+      value.className = "value";
+      value.textContent =
+        Number(r.qps || 0).toFixed(1) + " qps · p99 " +
+        Number(r.p99Ms || 0).toFixed(0) + " ms";
+      tile.appendChild(label);
+      tile.appendChild(value);
+      panel.appendChild(tile);
+    }
+  }
+
   function drawLossSpark(values) {
     // rolling per-batch mse sparkline (ModelHealth.mse window)
     const canvas = document.getElementById("lossSpark");
@@ -249,6 +290,7 @@
       case "Tenants": onTenants(json); break;
       case "ModelHealth": onModelHealth(json); break;
       case "Serving": onServing(json); break;
+      case "Fleet": onFleet(json); break;
       case "Series":
         // live frames buffer until the history backfill lands (ordering)
         if (!backfilled) pendingSeries.push(json);
@@ -279,6 +321,8 @@
     fetch("/api/model").then((r) => r.json()).then(onModelHealth).catch(() => {});
     // serving-plane backfill (snapshotStep -1 until a serve process posts)
     fetch("/api/serving").then((r) => r.json()).then(onServing).catch(() => {});
+    // read-fleet backfill (empty replicas[] off a router process)
+    fetch("/api/fleet").then((r) => r.json()).then(onFleet).catch(() => {});
     // backfill the chart from the server's rolling series window, then
     // apply any live frames that arrived while the fetch was in flight
     const flush = () => {

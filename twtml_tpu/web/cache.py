@@ -17,8 +17,8 @@ import collections
 import json as _json
 
 from ..telemetry.api_types import (
-    Config, Hosts, Metrics, ModelHealth, Series, Serving, Stats, Tenants,
-    decode, encode,
+    Config, Fleet, Hosts, Metrics, ModelHealth, Series, Serving, Stats,
+    Tenants, decode, encode,
 )
 from ..utils import get_logger
 
@@ -40,6 +40,7 @@ class ApiCache:
         self._tenants = Tenants()
         self._model = ModelHealth()
         self._serving = Serving()
+        self._fleet = Fleet()
         self._series: collections.deque[Series] = collections.deque(
             maxlen=SERIES_WINDOW
         )
@@ -69,6 +70,10 @@ class ApiCache:
     def serving(self) -> str:
         """Latest serving-plane view (in-memory only, like Stats)."""
         return encode(self._serving)
+
+    def fleet(self) -> str:
+        """Latest read-fleet view (in-memory only, like Stats)."""
+        return encode(self._fleet)
 
     def series(self) -> str:
         """Recent Series messages as a JSON array (chart backfill for
@@ -102,6 +107,8 @@ class ApiCache:
             self._model = data
         elif isinstance(data, Serving):
             self._serving = data
+        elif isinstance(data, Fleet):
+            self._fleet = data
         elif isinstance(data, Series):
             self._series.append(data)
         else:

@@ -56,11 +56,23 @@ class Server:
         # serve entry point makes POST /api/predict live; without one the
         # route answers 503 (this process has no model)
         self._serving = None
+        # fleet front door (ISSUE 11): a FleetRouter attached by the router
+        # entry point makes POST /api/predict a forwarding proxy over the
+        # replica fleet and GET /api/fleet a LIVE router view
+        self._fleet = None
 
     def attach_serving(self, plane) -> "Server":
         """Attach a ``serving.ServingPlane``: POST /api/predict submits to
         its coalescer and awaits the pipelined result future."""
         self._serving = plane
+        return self
+
+    def attach_fleet(self, router) -> "Server":
+        """Attach a ``serving.FleetRouter``: POST /api/predict forwards to
+        a replica per the route policy (failed replicas eject + retry on
+        another — the client never sees a single replica's death), and
+        GET /api/fleet answers with live router stats."""
+        self._fleet = router
         return self
 
     # -- handlers ------------------------------------------------------------
@@ -98,6 +110,16 @@ class Server:
         return web.Response(text=self.cache.serving(),
                             content_type="application/json")
 
+    async def _get_fleet(self, request: web.Request) -> web.StreamResponse:
+        # a router process answers LIVE (the view is plain host bookkeeping
+        # under a lock); any other process serves the cached additive view
+        if self._fleet is not None:
+            view = {"jsonClass": "Fleet", **self._fleet.stats()}
+            return web.Response(text=json.dumps(view),
+                                content_type="application/json")
+        return web.Response(text=self.cache.fleet(),
+                            content_type="application/json")
+
     async def _post_predict(self, request: web.Request) -> web.StreamResponse:
         """The serving front door: coalesced, pipelined inference from the
         attached plane's device-resident snapshot. Errors are JSON with an
@@ -110,10 +132,30 @@ class Server:
                 content_type="application/json",
             )
 
+        if self._fleet is not None:
+            # fleet front door: forward the raw body off the event loop
+            # (urllib blocks; the executor bounds concurrency) — replica
+            # failures retry/eject inside the router, so a client only
+            # sees 503 when the whole fleet is down this instant
+            body = await request.read()
+            loop = asyncio.get_event_loop()
+            # the router's OWN forward pool: asyncio's default executor is
+            # cpu+4 threads — 5 on the one-core host, which would cap a
+            # whole fleet at ~one replica's in-flight budget (measured,
+            # BENCHMARKS.md "Read fleet")
+            status, payload = await loop.run_in_executor(
+                getattr(self._fleet, "executor", None),
+                self._fleet.predict, body,
+            )
+            return web.Response(
+                body=payload, status=status,
+                content_type="application/json",
+            )
         plane = self._serving
         if plane is None:
             return fail(503, "serving not enabled on this server "
-                             "(start via twtml_tpu.apps.serve)")
+                             "(start via twtml_tpu.apps.serve or route a "
+                             "fleet via twtml_tpu.apps.router)")
         try:
             payload = json.loads(await request.text())
             rows = payload["rows"] if isinstance(payload, dict) else payload
@@ -232,6 +274,7 @@ class Server:
         app.router.add_get("/api/tenants", self._get_tenants)  # model plane
         app.router.add_get("/api/model", self._get_model)  # model health
         app.router.add_get("/api/serving", self._get_serving)  # serve plane
+        app.router.add_get("/api/fleet", self._get_fleet)  # read fleet
         app.router.add_post("/api/predict", self._post_predict)  # front door
         app.router.add_get("/", self._index)
         app.router.add_get("/{path:.+}", self._static)
