@@ -161,6 +161,30 @@ def main() -> None:
     if child == "device":
         print(json.dumps(measure()))
         return
+    if child == "wire":
+        # compact compressed-wire record (ISSUE 12): digram codec off/on,
+        # paired, object ingest, with the modeled upload-bound transport
+        # control — tools/bench_wirecodec.py is the full harness (both
+        # ingest regimes + the coalesced group-wire arms)
+        from tools.bench_wirecodec import measure as wire_measure
+
+        rec = wire_measure(
+            regime="object", n_tweets=32768, batch=4096, k=4, budget_s=25.0
+        )
+        modeled = rec["modeled_upload"]
+        print(json.dumps({
+            "wire_ratio": modeled["wire_ratio_single"],
+            "units_ratio": modeled["units_ratio"],
+            "paired_codec_cpu_control": (
+                rec["control"]["paired_single_codec_vs_raw"]
+            ),
+            "paired_codec_upload_bound": {
+                mbs: arms["single_codec_vs_raw"]
+                for mbs, arms in modeled["paired_upload_bound"].items()
+            },
+            "backend": rec["backend"],
+        }))
+        return
     if child == "serving":
         # compact serving-plane record (ISSUE 9): coalesced + depth-8
         # pipelined vs naive per-request under the 70 ms modeled-RTT
@@ -203,6 +227,14 @@ def main() -> None:
         serving_result, serving_err = _run_child("serving", 300.0)
         if serving_result is None:
             serving_result = {"error": serving_err}
+    # compressed-wire record (ISSUE 12; TWTML_BENCH_WIRE=0 skips): a short
+    # paired child — codec off/on in the object-ingest regime under the
+    # modeled upload-bound control (tools/bench_wirecodec.py)
+    wire_result = None
+    if os.environ.get("TWTML_BENCH_WIRE", "1") != "0":
+        wire_result, wire_err = _run_child("wire", 300.0)
+        if wire_result is None:
+            wire_result = {"error": wire_err}
 
     record: dict
     if device_result:
@@ -256,6 +288,10 @@ def main() -> None:
         # the serving plane's sustained read-path record (see the "serving"
         # child above; full paired harness: tools/bench_serving.py)
         record["serving"] = serving_result
+    if wire_result is not None:
+        # the compressed-wire record (see the "wire" child above; full
+        # paired harness: tools/bench_wirecodec.py)
+        record["wire"] = wire_result
     print(json.dumps(record))
 
 

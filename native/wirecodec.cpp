@@ -1,0 +1,45 @@
+// Digram wire codec — the C fast path of the compressed ragged units wire
+// (--wireCodec dict; twtml_tpu/features/wirecodec.py is the pure-numpy
+// ground truth and the two must emit IDENTICAL byte streams).
+//
+// Greedy left-to-right maximal munch over a 65536-entry pair LUT built by
+// the Python side from the one static dictionary (the LUT travels by
+// pointer each call, so the dictionary has exactly one definition). Input
+// is the uint8 (all-ASCII) units buffer; output bytes < 0x80 are literals,
+// >= 0x80 are dictionary codes expanding to two units on decode.
+//
+// The encode is ONE sequential pass at memory-bandwidth-class speed: the
+// host has a single usable core (CLAUDE.md), so this rides the native
+// ingest machinery like the wire emitter (tweetjson.cpp) rather than
+// adding a Python-level pass. No allocation, no threads, no state.
+
+#include <cstdint>
+
+extern "C" {
+
+// Encode n input bytes into out (capacity cap). lut is uint8[65536]:
+// lut[(a << 8) | b] = dictionary code index, 0xFF = no code. Returns the
+// number of output bytes, or -1 when the output would exceed cap (the
+// caller falls back to the raw wire — an encode that cannot shrink the
+// buffer is useless anyway).
+int64_t digram_encode(const uint8_t* in, int64_t n, const uint8_t* lut,
+                      uint8_t* out, int64_t cap) {
+  int64_t m = 0;
+  int64_t i = 0;
+  while (i < n) {
+    if (i + 1 < n) {
+      uint8_t code = lut[((uint16_t)in[i] << 8) | in[i + 1]];
+      if (code != 0xFF) {
+        if (m >= cap) return -1;
+        out[m++] = (uint8_t)(0x80 + code);
+        i += 2;
+        continue;
+      }
+    }
+    if (m >= cap) return -1;
+    out[m++] = in[i++];
+  }
+  return m;
+}
+
+}  // extern "C"

@@ -37,6 +37,18 @@ def test_sharded_2e18_2d_runs_on_virtual_mesh():
     assert rec["tweets_per_sec"] > 0
 
 
+def test_wire_codec_measures():
+    """The compressed-wire config (ISSUE 12) must run both windows (CPU
+    control + modeled upload-bound) and report the paired ratios and the
+    wire/units compression — plumbing only, tiny sizes."""
+    rec = bench_suite.run_config("wire_codec", 2048, 512)
+    assert rec["wire_ratio"] >= 1.0
+    assert rec["units_ratio"] >= 1.0
+    assert rec["paired_codec_cpu_control"] > 0
+    assert rec["paired_codec_upload55"] > 0
+    assert rec["paired_group_codec_upload55"] > 0
+
+
 def test_twitter_live_measures_local_protocol_without_creds(clean_properties):
     """Without creds, config #2 measures the REAL TwitterSource → train
     path against the in-process v1.1 server (VERDICT r2 #6), tagged so it

@@ -192,6 +192,27 @@ def test_metrics_frame_updates_ingest_guard_tiles():
     assert "degraded" not in h.el("rollbacks").class_set
 
 
+def test_metrics_frame_updates_wire_ratio_tile():
+    """r15 compressed wire: the wire.codec_ratio gauge (raw/compressed
+    units bytes, apps/common._record_wire_codec) renders on the pipeline
+    panel; a frame without it resets the tile to 1.00 (codec off)."""
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="Metrics",
+        counters={"wire.codec_fallbacks": 0},
+        gauges={"wire.codec_ratio": 1.472,
+                "wire.units_compressed_bytes": 11264},
+        health={"phase": "healthy", "rtt_ms": 70.0, "transitions": 0},
+    ))
+    assert h.el("wireRatio").text == "1.47"
+    h.ws.server_message(frame(
+        jsonClass="Metrics", counters={}, gauges={},
+        health={"phase": "healthy", "rtt_ms": 70.0, "transitions": 0},
+    ))
+    assert h.el("wireRatio").text == "1.00"
+
+
 def test_metrics_frame_updates_latency_tile():
     """r8: the derived fetch-latency p95 (Metrics.histograms, seconds)
     renders in ms on the pipeline panel."""

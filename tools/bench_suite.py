@@ -51,6 +51,7 @@ CONFIGS = [
     "sharded_2e18_2d",
     "multi_tenant_m8",
     "serving_qps",
+    "wire_codec",
 ]
 
 
@@ -481,6 +482,35 @@ def run_config(name: str, n_tweets: int, batch_size: int = 0) -> dict:
             "paired_speedup_cpu_control": (
                 rec["pipelined"]["paired_speedup_vs_naive"]
             ),
+        })
+    elif name == "wire_codec":
+        # the compressed ragged units wire (ISSUE 12): digram codec off vs
+        # on, paired on tools/pairedbench.py, in the object-ingest regime
+        # with the modeled upload-bound transport control —
+        # tools/bench_wirecodec.py is the full harness (both ingest
+        # regimes, group-wire arms); this is its compact per-config form
+        from tools.bench_wirecodec import measure as codec_measure
+
+        small = n_tweets < 16384  # plumbing-test sizes stay fast
+        rec = codec_measure(
+            regime="object", n_tweets=min(n_tweets, 32768),
+            batch=batch_size if explicit_batch else 4096,
+            k=2 if small else 4, budget_s=3.0 if small else 25.0,
+        )
+        modeled = rec["modeled_upload"]
+        out.update({
+            "wire_ratio": modeled["wire_ratio_single"],
+            "units_ratio": modeled["units_ratio"],
+            "paired_codec_cpu_control": (
+                rec["control"]["paired_single_codec_vs_raw"]
+            ),
+            "paired_codec_upload55": (
+                modeled["paired_upload_bound"]["55"]["single_codec_vs_raw"]
+            ),
+            "paired_group_codec_upload55": (
+                modeled["paired_upload_bound"]["55"]["group_codec_vs_raw"]
+            ),
+            "final_metric": rec["control"]["final_mse"],
         })
     elif name in ("sharded_dp4", "sharded_dp4_logistic", "sharded_2e18_2d"):
         from twtml_tpu.parallel import ParallelSGDModel, make_mesh

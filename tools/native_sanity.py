@@ -254,6 +254,45 @@ def _check_block_wire_parity(native, np) -> list[str]:
     return errors
 
 
+def _check_codec_parity(native, np) -> "list[str]":
+    """C ``digram_encode`` vs the pure-numpy ground truth
+    (features/wirecodec.encode_np), byte-for-byte, plus a decode
+    round-trip — the compressed-wire parity law (r15) under ASan/UBSan
+    (the greedy loop reads pairs at the buffer tail: the OOB class)."""
+    from twtml_tpu.features import wirecodec as wc
+
+    errors: list[str] = []
+    rng = random.Random(99)
+    bufs = [
+        np.zeros((0,), np.uint8),
+        np.zeros((1,), np.uint8),
+        np.zeros((4096,), np.uint8),
+        np.frombuffer(
+            b"the quick brown fox https://t.co/Ab12 jumps over the lazy "
+            b"dog again and again ", np.uint8,
+        ),
+    ]
+    for _ in range(200):
+        n = rng.randrange(0, 3000)
+        bufs.append(np.frombuffer(
+            bytes(rng.randrange(0, 128) for _ in range(n)), np.uint8
+        ).copy())
+    lut = wc.pair_lut()
+    for i, buf in enumerate(bufs):
+        ref = wc.encode_np(buf)
+        got = native.digram_encode(buf, lut) if buf.shape[0] >= 2 else ref
+        if got is None:
+            return [f"codec[{i}]: digram_encode unavailable in the "
+                    "instrumented library"]
+        if not np.array_equal(got, ref):
+            errors.append(f"codec[{i}]: C encode diverges from numpy "
+                          f"ground truth (n={buf.shape[0]})")
+            continue
+        if not np.array_equal(wc.decode_np(ref, buf.shape[0]), buf):
+            errors.append(f"codec[{i}]: decode round-trip mismatch")
+    return errors
+
+
 def main() -> int:
     os.environ.setdefault("TWTML_NATIVE_SANITIZE", "asan,ubsan")
     modes = {m.strip()
@@ -280,6 +319,7 @@ def main() -> int:
     errors += _check_hash_parity(native, hashing, np)
     errors += _check_pad_units(native, np)
     errors += _check_block_wire_parity(native, np)
+    errors += _check_codec_parity(native, np)
     for e in errors:
         print(f"native_sanity: FAIL {e}", file=sys.stderr)
     print(

@@ -393,6 +393,7 @@ def build_model(conf, model_cls=StreamingLinearRegressionWithSGD):
         )
         return model, (mesh.shape[mesh.axis_names[0]] if mesh else 1)
     mesh = build_mesh(conf, what=f"training ({model_cls.__name__})")
+    codec = getattr(conf, "effective_wire_codec", lambda: "off")()
     if mesh is not None:
         from ..parallel import ParallelSGDModel
 
@@ -405,6 +406,17 @@ def build_model(conf, model_cls=StreamingLinearRegressionWithSGD):
         import jax
 
         if jax.process_count() > 1:
+            if codec == "dict":
+                # the global wire assembly needs uniform per-segment bytes
+                # on every process; a cross-host agreed COMPRESSED bucket
+                # would add a collective to the lockstep tick (see
+                # parallel/distributed.py) — reject rather than silently
+                # shipping raw
+                raise SystemExit(
+                    "--wireCodec dict is single-host for now (the "
+                    "multi-host packed wire needs a cross-host agreed "
+                    "compressed bucket)"
+                )
             from ..parallel.distributed import MultiHostSGDModel
 
             # the app featurizes only THIS host's rows: its local batch
@@ -413,6 +425,9 @@ def build_model(conf, model_cls=StreamingLinearRegressionWithSGD):
                 MultiHostSGDModel(model, mesh),
                 max(1, model.num_data // jax.process_count()),
             )
+        # single-process mesh: the mesh packs compress per shard segment
+        # (parallel/sharding.py pack_for_wire / pack_group_for_wire)
+        model.wire_codec = codec if codec == "dict" else ""
         return model, model.num_data
     return model_cls.from_conf(conf), 1
 
@@ -1059,6 +1074,45 @@ class FetchWatchdog:
             future = reissue()
 
 
+_codec_fallback_warned = False
+
+
+def _record_wire_codec(wire, requested: str) -> None:
+    """Per-pack codec telemetry (r15 satellite): the compressed-units
+    split from ``features/batch.wire_composition`` → the
+    ``wire.units_compressed_bytes`` + ``wire.codec_ratio`` gauges on
+    /api/metrics (dashboard "wire ratio" tile). A pack that REQUESTED the
+    codec but shipped raw (non-ASCII-widened units, or an incompressible
+    batch) is the loud per-batch fallback: counted in
+    ``wire.codec_fallbacks`` and warned once per process. Pure layout
+    math — no array reads, no fetches."""
+    global _codec_fallback_warned
+    if not requested or requested == "off":
+        return
+    from ..features.batch import wire_composition
+
+    comp = wire_composition(wire)
+    reg = _metrics.get_registry()
+    phys = comp.get("units_compressed")
+    if phys is None:
+        reg.counter("wire.codec_fallbacks").inc()
+        reg.gauge("wire.codec_ratio").set(1.0)
+        reg.gauge("wire.units_compressed_bytes").set(comp.get("units", 0))
+        if not _codec_fallback_warned:
+            _codec_fallback_warned = True
+            log.warning(
+                "wire codec requested but this batch shipped RAW "
+                "(non-ASCII-widened units or incompressible) — counted "
+                "in wire.codec_fallbacks; further fallbacks are silent"
+            )
+        return
+    reg.gauge("wire.units_compressed_bytes").set(phys)
+    if phys:
+        reg.gauge("wire.codec_ratio").set(
+            round(comp["units"] / phys, 3)
+        )
+
+
 class SuperBatcher:
     """Group K featurized micro-batches into ONE device dispatch
     (``model.step_many``: a lax.scan of the ordinary train step) and re-emit
@@ -1117,7 +1171,8 @@ class SuperBatcher:
                  deterministic: bool = False, abort=None,
                  fetch_deadline_s: float = 0.0,
                  fetch_retries: "int | None" = None,
-                 wire_pack: str = "stacked"):
+                 wire_pack: str = "stacked",
+                 wire_codec: str = ""):
         from concurrent.futures import ThreadPoolExecutor
 
         self.model = model
@@ -1129,6 +1184,10 @@ class SuperBatcher:
         if wire_pack not in ("stacked", "group"):
             raise ValueError(f"wire_pack must be 'stacked' or 'group', got {wire_pack!r}")
         self.wire_pack = wire_pack
+        # compressed units wire (--wireCodec, r15): forwarded to the plain
+        # features/batch packers below; model-aware packers carry their own
+        # ``wire_codec`` attribute (parallel/sharding.py, tenants.py)
+        self.wire_codec = wire_codec
         # model-aware coalesced/group packers (mesh models shard the one
         # buffer; multi-host models assemble it globally); plain models use
         # the features/batch host packers
@@ -1310,7 +1369,9 @@ class SuperBatcher:
 
         if not self._coalesce(batches[0]):
             return stack_batches(batches)
-        packer = self._group_packer or pack_ragged_group
+        packer = self._group_packer or (
+            lambda bs: pack_ragged_group(bs, codec=self.wire_codec or None)
+        )
         tr = _trace.get()
         if tr.enabled:
             with tr.span(
@@ -1318,8 +1379,18 @@ class SuperBatcher:
             ) as sp:
                 wire = packer(batches)
                 sp.add(wire_bytes=wire_nbytes(wire))
-            return wire
-        return packer(batches)
+        else:
+            wire = packer(batches)
+        _record_wire_codec(wire, self._codec_requested())
+        return wire
+
+    def _codec_requested(self) -> str:
+        """The codec this batcher's wire is SUPPOSED to carry — the
+        pipeline-level setting for the plain packers, the model's own
+        attribute for model-aware packers (they pack with it directly)."""
+        if self._group_packer or self._single_packer:
+            return getattr(self.model, "wire_codec", "") or ""
+        return self.wire_codec
 
     def _close_group(self) -> None:
         if not self._buf:
@@ -1342,12 +1413,17 @@ class SuperBatcher:
                 if self._coalesce(batch):
                     from ..features.batch import pack_batch
 
-                    packer = self._single_packer or pack_batch
+                    packer = self._single_packer or (
+                        lambda b: pack_batch(
+                            b, codec=self.wire_codec or None
+                        )
+                    )
                     if tr.enabled:
                         with tr.span("wire_pack", mode="single"):
                             wire = packer(batch)
                     else:
                         wire = packer(batch)
+                    _record_wire_codec(wire, self._codec_requested())
                 import time as _time
 
                 t0 = _time.perf_counter()
@@ -1479,12 +1555,16 @@ class FetchPipeline:
                  boundary_every: int = 0, max_dispatch: int = 0,
                  pack: bool = False, deterministic: bool = False,
                  abort=None, fetch_deadline_s: float = 0.0,
-                 fetch_retries: "int | None" = None):
+                 fetch_retries: "int | None" = None,
+                 wire_codec: str = ""):
         from concurrent.futures import ThreadPoolExecutor
 
         self.model = model
         self.handle = handle
         self.depth = max(1, depth)
+        # compressed units wire (--wireCodec, r15): forwarded to the plain
+        # pack_batch below; model-aware packers carry their own attribute
+        self.wire_codec = wire_codec
         # one-buffer wire: measured +11.4% paired on the ragged wire
         # through this transport (per-ARRAY request overhead stops hiding
         # once the wire is lean); handlers still receive the UNPACKED
@@ -1597,7 +1677,9 @@ class FetchPipeline:
         if self.pack:
             from ..features.batch import pack_batch
 
-            packer = self._packer or pack_batch
+            packer = self._packer or (
+                lambda b: pack_batch(b, codec=self.wire_codec or None)
+            )
             if tr.enabled:
                 from ..features.batch import wire_nbytes
 
@@ -1606,6 +1688,11 @@ class FetchPipeline:
                     sp.add(wire_bytes=wire_nbytes(wire))
             else:
                 wire = packer(batch)
+            _record_wire_codec(
+                wire,
+                (getattr(self.model, "wire_codec", "") or "")
+                if self._packer else self.wire_codec,
+            )
         else:
             wire = batch
         # argument uploads ride the dispatch on this transport (no
@@ -1875,6 +1962,16 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
     pack = bool(getattr(stream, "ragged", False)) and getattr(
         model, "accepts_packed", False
     )
+    # compressed units wire (--wireCodec dict, r15): rides exactly the
+    # packed wire forms (pack_batch / the coalesced group wire / the mesh
+    # per-shard packs — compression compounds the per-array-overhead trap
+    # that made packing the lean-wire default). Model-aware packers carry
+    # their own wire_codec attribute (set in build_model / from_conf);
+    # this value drives the pipeline-level plain packers.
+    wire_codec = ""
+    if pack:
+        _codec = getattr(conf, "effective_wire_codec", lambda: "off")()
+        wire_codec = _codec if _codec == "dict" else ""
 
     if k <= 1:
         if conf.seconds <= 0:
@@ -1891,6 +1988,7 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
                 pack=pack,
                 deterministic=multihost,
                 abort=abort,
+                wire_codec=wire_codec,
             )
             if multihost:
                 pipeline_ref.append(pipe)  # empty-batch refunds (above)
@@ -1911,12 +2009,20 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
             if pack:
                 from ..features.batch import pack_batch
 
-                packer = getattr(model, "pack_for_wire", None) or pack_batch
+                packer = getattr(model, "pack_for_wire", None) or (
+                    lambda b: pack_batch(b, codec=wire_codec or None)
+                )
                 if tr.enabled:
                     with tr.span("wire_pack", mode="single"):
                         wire = packer(batch)
                 else:
                     wire = packer(batch)
+                _record_wire_codec(
+                    wire,
+                    (getattr(model, "wire_codec", "") or "")
+                    if getattr(model, "pack_for_wire", None)
+                    else wire_codec,
+                )
             else:
                 wire = batch
             td = _time.perf_counter()
@@ -1960,6 +2066,7 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
             )() == "group"
             else "stacked"
         ),
+        wire_codec=wire_codec,
     )
     if multihost:
         pipeline_ref.append(batcher)  # empty-batch refunds (above)

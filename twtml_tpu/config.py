@@ -160,6 +160,14 @@ class ConfArguments:
                 "wirePack must be 'auto', 'stacked' or 'group', got "
                 f"{self.wirePack!r}"
             )
+        # compressed ragged units wire (r15): C-side digram encode,
+        # in-jit gather-expand decode (features/wirecodec.py)
+        self.wireCodec: str = conf.get("wireCodec", "auto")
+        if self.wireCodec not in ("auto", "off", "dict"):
+            raise ValueError(
+                "wireCodec must be 'auto', 'off' or 'dict', got "
+                f"{self.wireCodec!r}"
+            )
         self.recycleAfterMb: int = int(conf.get("recycleAfterMb", "0"))
         # multi-tenant model plane (r10): M models, one jit program, one fetch
         self.tenants: int = int(conf.get("tenants", "1"))
@@ -487,6 +495,20 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                                                (currently stacked pending a tunnel-regime
                                                verdict; bit-identical features either way).
                                                Default: {self.wirePack}
+  --wireCodec <auto|off|dict>                  Compressed ragged units wire: 'dict' digram-
+                                               compresses the uint8 (all-ASCII) units buffer
+                                               in the one C ingest pass (static dictionary,
+                                               ~1.3-2x on tweet text) and decodes it INSIDE
+                                               the jit program ahead of the ragged re-pad —
+                                               byte-identical units (tests/test_wirecodec.py).
+                                               Applies to the packed wire forms; non-ASCII
+                                               (uint16) units and incompressible batches ship
+                                               raw, counted in wire.codec_fallbacks. With
+                                               --superBatch, 'dict' + --wirePack auto resolves
+                                               the group (coalesced) wire. auto = the measured
+                                               default recorded in BENCHMARKS.md "Compressed
+                                               wire" (currently off pending a tunnel-regime
+                                               verdict). Default: {self.wireCodec}
 """
 
     def parse(self, args: list[str]) -> "ConfArguments":
@@ -589,6 +611,10 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
         elif flag == "--wirePack":
             self.wirePack = take()
             if self.wirePack not in ("auto", "stacked", "group"):
+                self.printUsage(1)
+        elif flag == "--wireCodec":
+            self.wireCodec = take()
+            if self.wireCodec not in ("auto", "off", "dict"):
                 self.printUsage(1)
         elif flag == "--recycleAfterMb":
             self.recycleAfterMb = int(take())
@@ -709,10 +735,43 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
         change — holds the default at STACKED until the tunnel-regime bench
         clears (tools/bench_superwire.py; BENCHMARKS.md "Lean wire v2"
         records the CPU control, which is wire-insensitive by design).
-        Explicit ``--wirePack group``/``stacked`` always wins."""
+        Explicit ``--wirePack group``/``stacked`` always wins — except the
+        contradictory ``--wirePack stacked --wireCodec dict``, which is
+        rejected below: the codec lives on the PACKED wire forms
+        (compression compounds the per-array-overhead trap that made
+        packing the lean-wire default), so a stacked superbatch wire would
+        silently ship the group's batches uncompressed."""
+        if self.effective_wire_codec() == "dict":
+            if self.wirePack == "stacked":
+                raise ValueError(
+                    "--wirePack stacked contradicts --wireCodec dict: the "
+                    "codec rides the packed one-buffer wire (use "
+                    "--wirePack group, or drop the codec)"
+                )
+            return "group"
         if self.wirePack != "auto":
             return self.wirePack
         return "stacked"
+
+    def effective_wire_codec(self) -> str:
+        """Resolve ``--wireCodec auto`` to the measured-default units
+        codec. ``dict`` (the digram codec, features/wirecodec.py) is only
+        meaningful on the ragged raw-units wire — explicit ``dict`` with a
+        padded/host-hash wire is rejected, like explicit ragged with
+        ``--hashOn host``. ``auto`` follows the wirePack precedent: OFF
+        until the tunnel-regime paired verdict clears (the r2/r3 law —
+        measure in the target regime before shipping a wire change;
+        BENCHMARKS.md "Compressed wire" records the modeled-transport
+        paired win and the standing auto decision)."""
+        if self.wireCodec in ("off", "auto"):
+            return "off"
+        if self.effective_wire() != "ragged":
+            raise ValueError(
+                "--wireCodec dict needs the ragged raw-units wire "
+                "(--wire ragged, or auto with --hashOn device and "
+                "--seconds 0)"
+            )
+        return "dict"
 
     def effective_max_queue_rows(self) -> int:
         """Resolve ``--maxQueueRows``: explicit > 0 wins; 0 (the default)

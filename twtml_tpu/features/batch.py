@@ -261,7 +261,11 @@ def wire_composition(batch) -> "dict[str, int]":
     ``offsets`` the row-boundary sideband (offsets/length deltas), and
     ``sideband`` the numeric/label/mask tail. A PackedBatch reports its
     layout's recorded fields (× segment count), so the packed and unpacked
-    views of one batch agree byte-for-byte."""
+    views of one batch agree byte-for-byte. A codec layout
+    (``--wireCodec dict``) keeps ``units`` as the RAW units bytes (still
+    agreeing with the unpacked view) and adds ``units_compressed`` — the
+    bytes the transport actually carries; their quotient is the live
+    ``wire.codec_ratio`` gauge (apps/common.py)."""
     if isinstance(batch, PackedBatch):
         tag = batch.layout[0]
         if tag in ("RaggedShardSegments", "RaggedGroupSegments"):
@@ -291,6 +295,16 @@ def wire_composition(batch) -> "dict[str, int]":
             out[name] += segs * int(
                 np.prod(shape, dtype=np.int64)
             ) * np.dtype(dt).itemsize
+        codec_tag = _layout_codec(batch.layout)
+        if codec_tag is not None:
+            # compressed wire: "units" stays the raw bytes (the unpacked
+            # view), "units_compressed" is what the transport carries
+            out["units_compressed"] = out["units"]
+            out["units"] = (
+                int(np.prod(codec_tag[1], dtype=np.int64))
+                if tag == "RaggedUnitBatch"
+                else segs * int(codec_tag[1])
+            )
         return out
     groups = {
         "units": ("units", "token_idx", "token_val"),
@@ -461,6 +475,96 @@ def _decode_offsets(arr, num_segments: int):
     return offsets_from_deltas(arr, num_segments)
 
 
+# ---- compressed units wire (r15, --wireCodec dict) -------------------------
+# The digram codec (features/wirecodec.py: static-dictionary byte-pair
+# coding, C-side encode, in-jit gather-expand decode) shrinks the dominant
+# wire tensor another ~1.4-2x on ASCII tweet text. It applies ONLY to the
+# PACKED wire forms (pack_batch / pack_ragged_sharded / pack_ragged_group):
+# compression compounds the per-array-overhead trap that already made
+# packing the lean-wire default (+11.4% paired, r3), and every host-side
+# consumer between featurize and pack (tenant routing, shard alignment,
+# stacking) indexes RAW units by offset. Two gates, both loud and lossless:
+# uint16 (non-ASCII-widened) units ship uncompressed — a metadata gate,
+# like the int32 offset fallback — and a batch whose bucketed encoding is
+# not strictly smaller than its raw buffer ships raw, recorded in the
+# layout and counted by the app seam (wire.codec_fallbacks).
+
+
+def _encode_units_codec(units: np.ndarray, codec: "str | None"):
+    """Bucketed digram codes for an eligible raw units buffer, or None →
+    the raw wire (codec off, uint16 units, or incompressible batch)."""
+    if codec is None or codec in ("", "off"):
+        return None
+    if codec != "dict":
+        raise ValueError(f"unknown wire codec {codec!r} (know: dict)")
+    units = np.asarray(units)
+    if units.dtype != np.uint8:
+        return None  # non-ASCII-widened wire: uncompressed, like int32 offsets
+    from .wirecodec import encode_bucketed
+
+    return encode_bucketed(units.reshape(-1))
+
+
+def _encode_units_segments(
+    units: np.ndarray, num_segments: int, codec: "str | None"
+):
+    """Per-segment digram codes [num_segments, shared bucket] for a
+    SEGMENTED raw units buffer (shard sub-buffers / group segments —
+    each must decode independently under its device's slice), or None →
+    raw wire. The bucket is joint (max segment, rounded) so every segment
+    is the same static shape; all-or-nothing per pack."""
+    if codec is None or codec in ("", "off"):
+        return None
+    if codec != "dict":
+        raise ValueError(f"unknown wire codec {codec!r} (know: dict)")
+    u = np.asarray(units)
+    if u.dtype != np.uint8:
+        return None  # non-ASCII-widened wire ships uncompressed
+    from .wirecodec import encode, encoded_bucket
+
+    rows = u.reshape(num_segments, -1)
+    enc = [encode(r) for r in rows]
+    bucket = encoded_bucket(max(e.shape[0] for e in enc))
+    if bucket >= rows.shape[1]:
+        return None  # incompressible: the raw wire is the smaller wire
+    out = np.zeros((num_segments, bucket), np.uint8)
+    for i, e in enumerate(enc):
+        out[i, : e.shape[0]] = e
+    return out
+
+
+def _decode_units(arr, out_len: int):
+    """Codec-wire decode for the unpack paths: host numpy decodes via the
+    wirecodec twin; a traced device array decodes in-program
+    (ops/ragged.units_from_codes) — either way the rebuilt units are
+    bit-identical to the uncompressed wire. ``arr`` holds per-stream codes
+    along the LAST axis ([..., M] → [..., out_len]; leading axes pass
+    through, so stacked/segmented wires decode in one call)."""
+    if isinstance(arr, np.ndarray):
+        from .wirecodec import decode_np
+
+        return decode_np(arr, out_len)
+    from ..ops.ragged import units_from_codes
+
+    return units_from_codes(arr, out_len)
+
+
+def _layout_codec(layout: tuple) -> "tuple | None":
+    """The codec entry ``("dict", raw_units_per_stream)`` of a packed
+    layout, or None for the raw wire. One reader for all three packed
+    tags, so the position of the appended entry cannot drift."""
+    extra = layout[2] if len(layout) > 2 else None
+    if not extra:
+        return None
+    at = {
+        "RaggedUnitBatch": 3, "RaggedShardSegments": 3,
+        "RaggedGroupSegments": 4,
+    }.get(layout[0])
+    if at is None or len(extra) <= at:
+        return None
+    return extra[at]
+
+
 def ragged_wire_arrays(
     units: np.ndarray, offsets: np.ndarray, n: int, b: int, narrow: bool
 ) -> tuple[np.ndarray, np.ndarray]:
@@ -484,6 +588,7 @@ def ragged_wire_arrays(
 def pack_ragged_sharded(
     rb: "RaggedUnitBatch", num_shards_out: int = 0,
     narrow_offsets: "bool | None" = None,
+    codec: "str | None" = None,
 ) -> PackedBatch:
     """A SHARD-ALIGNED ragged batch → one wire buffer laid out PER SHARD, so
     a mesh data axis can shard the single buffer (r5: the +11.4% packing
@@ -508,7 +613,13 @@ def pack_ragged_sharded(
     ``narrow_offsets`` (default: auto from the static ``row_len`` gate,
     ``offsets_narrow``) ships the per-shard offsets as uint16 LENGTH DELTAS
     instead of [B_s+1] int32 — the Lean-wire-v2 sideband shrink; the unpack
-    cumsums them back in-program, bit-identically."""
+    cumsums them back in-program, bit-identically.
+
+    ``codec="dict"`` (r15, ``--wireCodec``) digram-compresses each shard's
+    units sub-buffer into a shared static bucket; the unpack gather-expands
+    them back in-program ahead of the re-pad — byte-identical units
+    (tests/test_wirecodec.py). Ineligible/incompressible batches keep the
+    raw layout (see ``_encode_units_segments``)."""
     s = rb.num_shards
     b = rb.mask.shape[0]
     bl = b // s
@@ -522,10 +633,14 @@ def pack_ragged_sharded(
         if narrow
         else (rb.offsets, (bl + 1,))
     )
+    codes = _encode_units_segments(rb.units, s, codec)
+    units_wire = (
+        (rb.units, (n_sb,)) if codes is None else (codes, (codes.shape[1],))
+    )
     fields = tuple(
         np.ascontiguousarray(np.asarray(a).reshape((s,) + shape))
         for a, shape in (
-            (rb.units, (n_sb,)),
+            units_wire,
             offs_wire,
             (rb.numeric, (bl, NUM_NUMBER_FEATURES)),
             (rb.label, (bl,)),
@@ -535,7 +650,8 @@ def pack_ragged_sharded(
     layout = (
         "RaggedShardSegments",
         tuple((f.shape[1:], f.dtype.str) for f in fields),
-        (rb.row_len, num_shards_out or s, "u16delta" if narrow else "i32"),
+        (rb.row_len, num_shards_out or s, "u16delta" if narrow else "i32")
+        + (() if codes is None else (("dict", n_sb),)),
     )
     buffer = np.concatenate(
         [f.view(np.uint8).reshape(s, -1) for f in fields], axis=1
@@ -550,10 +666,13 @@ def _unpack_ragged_shards(buffer, layout: tuple) -> "RaggedUnitBatch":
     shard-local batch (num_shards=1 — the body is per-shard by
     construction). A ``u16delta`` layout (narrow offset wire) cumsums the
     per-row length deltas back to segment-relative offsets here —
-    in-program on device, numpy on host — before the batch is rebuilt."""
+    in-program on device, numpy on host — before the batch is rebuilt; a
+    codec layout (``--wireCodec dict``) likewise gather-expands each
+    shard's digram codes back to its raw units sub-buffer first."""
     fields_meta = layout[1]
     row_len, s_total = layout[2][0], layout[2][1]
     offs_mode = layout[2][2] if len(layout[2]) > 2 else "i32"
+    codec_tag = _layout_codec(layout)
     per_shard = sum(
         int(np.prod(shape, dtype=np.int64)) * np.dtype(dt).itemsize
         for shape, dt in fields_meta
@@ -590,6 +709,11 @@ def _unpack_ragged_shards(buffer, layout: tuple) -> "RaggedUnitBatch":
         off += nbytes
         # flatten the segment axis back into the leading dim
         fields.append(arr.reshape((arr.shape[0] * shape[0],) + shape[1:]))
+    if codec_tag is not None:
+        n_sb_raw = int(codec_tag[1])
+        fields[0] = _decode_units(
+            fields[0].reshape(s_here, -1), n_sb_raw
+        ).reshape(s_here * n_sb_raw)
     if offs_mode == "u16delta":
         fields[1] = _decode_offsets(fields[1], s_here)
     return RaggedUnitBatch(
@@ -600,6 +724,7 @@ def _unpack_ragged_shards(buffer, layout: tuple) -> "RaggedUnitBatch":
 def pack_ragged_group(
     batches, num_shards_out: int = 0,
     narrow_offsets: "bool | None" = None,
+    codec: "str | None" = None,
 ) -> PackedBatch:
     """K same-signature ragged batches → ONE contiguous uint8 wire buffer
     (the coalesced superbatch wire, Lean wire v2).
@@ -627,7 +752,9 @@ def pack_ragged_group(
     shard alignment) — the SuperBatcher's signature grouping guarantees
     this, so each distinct (signature, K) compiles exactly one program.
     ``num_shards_out`` mirrors ``pack_ragged_sharded`` (multi-host callers
-    pack local shards, the layout carries the global count)."""
+    pack local shards, the layout carries the global count); ``codec``
+    mirrors it too (per-segment digram compression, shared bucket,
+    all-or-nothing raw fallback — see ``_encode_units_segments``)."""
     if not batches:
         raise ValueError("cannot pack an empty group")
     first = batches[0]
@@ -667,20 +794,28 @@ def pack_ragged_group(
     )
     # [S, K, ...] per field: shard-major so P(data) on the flattened buffer
     # hands each device exactly its own K segments
-    fields = tuple(
+    fields = list(
         np.ascontiguousarray(np.stack(
             [np.asarray(get(rb)).reshape((s,) + shape) for rb in batches],
             axis=1,
         ))
         for get, shape in specs
     )
+    # compressed units wire (``--wireCodec dict``): every (shard, k)
+    # segment's sub-buffer encodes independently into one shared bucket —
+    # each device slice / scan step decodes exactly its own segments
+    codes = _encode_units_segments(fields[0], s * k, codec)
+    if codes is not None:
+        fields[0] = np.ascontiguousarray(
+            codes.reshape(s, k, codes.shape[1])
+        )
     layout = (
         "RaggedGroupSegments",
         tuple((f.shape[2:], f.dtype.str) for f in fields),
         (
             first.row_len, num_shards_out or s, k,
             "u16delta" if narrow else "i32",
-        ),
+        ) + (() if codes is None else (("dict", n_sb),)),
     )
     buffer = np.concatenate(
         [f.view(np.uint8).reshape(s, k, -1) for f in fields], axis=2
@@ -708,7 +843,8 @@ def _unpack_ragged_group(buffer, layout: tuple) -> "RaggedUnitBatch":
     buffer holds ONE shard's K segments and the zero-copy bitcasts rebuild
     the shard-local stacked batch the scanned step consumes."""
     fields_meta = layout[1]
-    row_len, _s_total, k, offs_mode = layout[2]
+    row_len, _s_total, k, offs_mode = layout[2][:4]
+    codec_tag = _layout_codec(layout)
     per_seg = sum(
         int(np.prod(shape, dtype=np.int64)) * np.dtype(dt).itemsize
         for shape, dt in fields_meta
@@ -749,6 +885,11 @@ def _unpack_ragged_group(buffer, layout: tuple) -> "RaggedUnitBatch":
             arr = lax.bitcast_convert_type(chunk, dt).reshape((k,) + shape)
         off += nbytes
         fields.append(arr)
+    if codec_tag is not None:
+        n_sb_raw = int(codec_tag[1])
+        fields[0] = _decode_units(
+            fields[0].reshape(k, s_here, -1), n_sb_raw
+        ).reshape(k, s_here * n_sb_raw)
     if offs_mode == "u16delta":
         fields[1] = _decode_offsets_stacked(fields[1], s_here)
     return RaggedUnitBatch(
@@ -759,13 +900,17 @@ def _unpack_ragged_group(buffer, layout: tuple) -> "RaggedUnitBatch":
 def pack_batch(
     batch: "FeatureBatch | UnitBatch | RaggedUnitBatch",
     narrow_offsets: "bool | None" = None,
+    codec: "str | None" = None,
 ) -> PackedBatch:
     """Flatten a host batch into one uint8 wire buffer (cheap memcpy).
     RaggedUnitBatch packs its five arrays too, with ``row_len`` carried in
     the static layout (third element) — and its offsets ship as uint16
     length deltas whenever the static ``row_len`` gate allows
     (``offsets_narrow``; the in-jit unpack cumsums them back,
-    bit-identically — the Lean-wire-v2 sideband shrink)."""
+    bit-identically — the Lean-wire-v2 sideband shrink). ``codec="dict"``
+    additionally digram-compresses the ragged units buffer (one stream —
+    this flat layout is never device-sliced), decoded in-jit by the
+    unpack; ineligible/incompressible batches keep the raw layout."""
     if isinstance(batch, RaggedUnitBatch):
         narrow = (
             offsets_narrow(batch.row_len) if narrow_offsets is None
@@ -776,14 +921,16 @@ def pack_batch(
             if narrow
             else batch.offsets
         )
+        units = np.asarray(batch.units)
+        codes = _encode_units_codec(units, codec)
         arrays: tuple = (
-            batch.units, offs, batch.numeric, batch.label,
-            batch.mask,
+            units if codes is None else codes, offs, batch.numeric,
+            batch.label, batch.mask,
         )
         extra: "tuple | None" = (
             batch.row_len, batch.num_shards,
             "u16delta" if narrow else "i32",
-        )
+        ) + (() if codes is None else (("dict", tuple(units.shape)),))
     else:
         arrays = tuple(batch)
         extra = None
@@ -828,6 +975,13 @@ def unpack_batch(buffer, layout: tuple):
     if cls is RaggedUnitBatch:
         extra = layout[2]
         num_shards = extra[1] if len(extra) > 1 else 1
+        codec_tag = _layout_codec(layout)
+        if codec_tag is not None:
+            raw_shape = tuple(codec_tag[1])
+            n_raw = int(np.prod(raw_shape, dtype=np.int64))
+            fields[0] = _decode_units(
+                fields[0].reshape(-1), n_raw
+            ).reshape(raw_shape)
         if len(extra) > 2 and extra[2] == "u16delta":
             fields[1] = _decode_offsets(fields[1], num_shards)
         return RaggedUnitBatch(

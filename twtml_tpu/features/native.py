@@ -24,6 +24,7 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__f
 _SRCS = [
     os.path.join(_REPO_ROOT, "native", "fasthash.cpp"),
     os.path.join(_REPO_ROOT, "native", "tweetjson.cpp"),
+    os.path.join(_REPO_ROOT, "native", "wirecodec.cpp"),
 ]
 # TWTML_NATIVE_LIB: alternate build/load path for the shared library. The
 # sanitizer harness (tools/native_sanity.py) builds an ASan/UBSan-
@@ -86,6 +87,10 @@ _tried = False
 # and block sources degrade LOUDLY to the ParsedBlock path — one warning +
 # a registry counter, never a ctypes AttributeError mid-stream
 _wire_missing = False
+# same degrade seam for the digram wire-codec encoder (r15): a stale
+# library missing ``digram_encode`` only flags this, and the codec falls
+# back to the byte-identical numpy encoder (features/wirecodec.encode_np)
+_codec_missing = False
 
 
 def _build() -> bool:
@@ -150,8 +155,9 @@ def get_lib() -> ctypes.CDLL | None:
 def _try_degraded_load() -> ctypes.CDLL | None:
     """Last-resort load of a stale library: every pre-wire symbol must
     bind (those AttributeErrors stay fatal — the lib is unusably old), but
-    a missing wire emitter only flags ``_wire_missing`` so block sources
-    fall back to the ParsedBlock path instead of dying mid-stream."""
+    a missing wire emitter / codec encoder only flags ``_wire_missing`` /
+    ``_codec_missing`` so block sources fall back to the ParsedBlock path
+    (and the codec to its numpy encoder) instead of dying mid-stream."""
     try:
         return _load(_LIB, strict=False)
     except (OSError, AttributeError) as exc:
@@ -162,8 +168,9 @@ def _try_degraded_load() -> ctypes.CDLL | None:
 
 def _load(path: str, strict: bool = True) -> ctypes.CDLL:
     """dlopen + bind every exported symbol; AttributeError = stale library.
-    ``strict=False`` tolerates exactly one absence — the wire emitter —
-    by flagging ``_wire_missing`` instead of raising (see get_lib)."""
+    ``strict=False`` tolerates the post-r6 additions — the wire emitter
+    and the codec encoder — by flagging ``_wire_missing`` /
+    ``_codec_missing`` instead of raising (see get_lib)."""
     lib = ctypes.CDLL(path)
     lib.fasthash_batch.restype = ctypes.c_int32
     lib.fasthash_batch.argtypes = [
@@ -231,6 +238,7 @@ def _load(path: str, strict: bool = True) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int64),  # bad_lines
     ]
     _bind_wire(lib, strict)
+    _bind_codec(lib, strict)
     return lib
 
 
@@ -275,6 +283,62 @@ def _bind_wire(lib: ctypes.CDLL, strict: bool) -> None:
         ctypes.POINTER(ctypes.c_int64),  # needs_wide (out)
     ]
     _wire_missing = False
+
+
+def _bind_codec(lib: ctypes.CDLL, strict: bool) -> None:
+    """Bind the digram wire-codec encoder (native/wirecodec.cpp). Same
+    degrade contract as ``_bind_wire``: strict loads raise (get_lib
+    rebuilds), degraded loads flag ``_codec_missing`` ONCE and the codec
+    keeps running on the byte-identical numpy encoder."""
+    global _codec_missing
+    try:
+        fn = lib.digram_encode
+    except AttributeError:
+        if strict:
+            raise
+        _codec_missing = True
+        log.warning(
+            "native library is stale: digram_encode missing — the wire "
+            "codec uses the numpy encoder (delete native/libfasthash.so "
+            "to force a rebuild of the C fast path)"
+        )
+        from ..telemetry import metrics as _metrics
+
+        _metrics.get_registry().counter("native.codec_degraded").inc()
+        return
+    fn.restype = ctypes.c_int64
+    fn.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8),  # in
+        ctypes.c_int64,  # n
+        ctypes.POINTER(ctypes.c_uint8),  # lut[65536]
+        ctypes.POINTER(ctypes.c_uint8),  # out
+        ctypes.c_int64,  # cap
+    ]
+    _codec_missing = False
+
+
+def digram_encode(buf: np.ndarray, lut: np.ndarray) -> "np.ndarray | None":
+    """C greedy digram encode of a uint8 buffer (features/wirecodec.py owns
+    the dictionary and the numpy ground truth; the two are byte-identical
+    by construction and differential-tested). None when the native library
+    is unavailable or predates the encoder — callers fall back to
+    ``wirecodec.encode_np``. The output can never exceed the input length
+    (a pair shrinks, a literal copies), so ``n`` capacity always fits."""
+    lib = get_lib()
+    if lib is None or _codec_missing:
+        return None
+    n = int(buf.shape[0])
+    out = np.empty((n,), np.uint8)
+    m = lib.digram_encode(
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n,
+        lut.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n,
+    )
+    if m < 0:  # cannot happen with cap = n; be loud if it ever does
+        raise RuntimeError("digram_encode overflowed its full-size buffer")
+    return out[:m].copy()
 
 
 def available() -> bool:

@@ -19,6 +19,7 @@ single-device layout.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -40,6 +41,53 @@ def offsets_from_deltas(deltas, num_segments: int = 1):
     zero = jnp.zeros(lead + (num_segments, 1), jnp.int32)
     out = jnp.concatenate([zero, jnp.cumsum(d, axis=-1)], axis=-1)
     return out.reshape(lead + (-1,))
+
+
+def units_from_codes(codes, out_len: int):
+    """Digram-coded units wire → the raw uint8 units buffer, in program —
+    the decode half of the COMPRESSED wire (``--wireCodec dict``:
+    features/wirecodec.py encodes the all-ASCII uint8 units into literal
+    bytes < 0x80 and two-unit dictionary codes >= 0x80 on the host; this
+    rebuilds the exact buffer ahead of the ragged re-pad, so every
+    downstream consumer — ``ragged_repad`` first — sees the uncompressed
+    wire bit-identically).
+
+    Decode is a bounded gather-expand + cumsum, the ``offsets_from_deltas``
+    family: per-code expanded lengths (1 or 2) cumsum to output positions,
+    one searchsorted maps each of the ``out_len`` output slots back to its
+    code (a vectorized binary search — gathers only, never a scatter or a
+    data-dependent loop: the TW004/XLA serialization trap), and a two-entry
+    table gather materializes the unit. The 128×2 decode table is a static
+    compile-time constant (the dictionary ships in the program, not on the
+    wire). ``out_len`` is static (the raw units bucket recorded in the
+    packed layout); trailing padding codes past it are never gathered.
+
+    Shapes: [..., M] codes → [..., out_len] uint8 units (leading axes pass
+    through — the stacked [K, M] group wire decodes in one call)."""
+    from ..features.wirecodec import CODE_BASE, decode_table
+
+    table = jnp.asarray(decode_table())  # [128, 2] uint8, baked constant
+
+    def one(c1d):
+        c = c1d.astype(jnp.int32)
+        lens = 1 + (c >= CODE_BASE).astype(jnp.int32)
+        ends = jnp.cumsum(lens)  # inclusive expansion ends, [M]
+        t = jnp.arange(out_len, dtype=jnp.int32)
+        j = jnp.clip(
+            jnp.searchsorted(ends, t, side="right"), 0, c.shape[0] - 1
+        ).astype(jnp.int32)
+        k = jnp.clip(t - (ends[j] - lens[j]), 0, 1)
+        cj = c[j]
+        exp = table[jnp.clip(cj - CODE_BASE, 0, CODE_BASE - 1), k]
+        return jnp.where(cj < CODE_BASE, cj, exp.astype(jnp.int32)).astype(
+            jnp.uint8
+        )
+
+    if codes.ndim == 1:
+        return one(codes)
+    lead = codes.shape[:-1]
+    out = jax.vmap(one)(codes.reshape((-1, codes.shape[-1])))
+    return out.reshape(lead + (out_len,))
 
 
 def ragged_repad(units, offsets, row_len: int, rows: int | None = None,

@@ -161,7 +161,13 @@ class MultiHostSGDModel:
     _local_rows = staticmethod(local_rows)
 
     # the ragged wire packs per shard on multi-host too (pack_for_wire);
-    # the app-side pack opt-in keys off this (apps/common.py)
+    # the app-side pack opt-in keys off this (apps/common.py).
+    # --wireCodec is NOT applied here by design: the compressed bucket is
+    # data-dependent per host, and the global buffer assembly below needs
+    # uniform per-segment bytes on EVERY process — agreeing a compressed
+    # bucket would add a collective to the lockstep tick (the PR 1/5 law
+    # says don't), so multi-host ships the raw packed wire and the app
+    # driver REJECTS --wireCodec dict on multi-host runs (apps/common.py).
     accepts_packed = True
 
     def step(self, local_batch):

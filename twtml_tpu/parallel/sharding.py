@@ -572,6 +572,12 @@ class ParallelSGDModel:
     # per shard (pack_ragged_sharded); the app-side pack opt-in keys off
     # this capability (apps/common.py)
     accepts_packed = True
+    # compressed units wire (r15, --wireCodec): set by the app driver when
+    # the codec is effective — the mesh packs below compress each shard
+    # segment into a shared bucket (single-process mesh: this process
+    # picks the bucket freely; the MULTI-HOST model keeps the raw wire —
+    # a cross-host agreed compressed bucket would need a new collective)
+    wire_codec = ""
 
     def prepare(self, batch):
         """Host-side shard alignment WITHOUT device placement — the
@@ -594,7 +600,9 @@ class ParallelSGDModel:
                 "pack_for_wire is the ragged wire's mesh pack; padded "
                 "batches shard as plain arrays"
             )
-        pb = pack_ragged_sharded(self.prepare(batch))
+        pb = pack_ragged_sharded(
+            self.prepare(batch), codec=self.wire_codec or None
+        )
         return PackedBatch(
             jax.device_put(
                 pb.buffer, NamedSharding(self.mesh, P(self.data_axis))
@@ -610,7 +618,9 @@ class ParallelSGDModel:
         K segments; ``step_many`` consumes it via the scanned unpack."""
         from ..features.batch import pack_ragged_group
 
-        pb = pack_ragged_group([self.prepare(b) for b in batches])
+        pb = pack_ragged_group(
+            [self.prepare(b) for b in batches], codec=self.wire_codec or None
+        )
         return PackedBatch(
             jax.device_put(
                 pb.buffer, NamedSharding(self.mesh, P(self.data_axis))

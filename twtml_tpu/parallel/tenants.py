@@ -168,6 +168,7 @@ class TenantStackModel:
         gram_int8: bool | None = None,
         tenant_key: str = "hash",
         wire_pack: str = "stacked",
+        wire_codec: str = "",
         mesh=None,
         step_sizes=None,
         l2_regs=None,
@@ -187,6 +188,11 @@ class TenantStackModel:
         self.dtype = dtype
         self.tenant_key = tenant_key
         self.wire_pack = wire_pack
+        # compressed units wire on the coalesced tenant wire (r15,
+        # --wireCodec): the group pack digram-compresses each tenant
+        # segment; "" / "off" = raw. Stacked wire ships raw by design
+        # (the codec rides the packed one-buffer forms only).
+        self.wire_codec = wire_codec
         self.mapping = mapping
         self.mesh = mesh
         # --modelWatch: the mapped step computes each tenant's quality
@@ -390,10 +396,11 @@ class TenantStackModel:
             # the 2D (tenants-on-model-axis) plane ships the stacked wire
             and self._tenant_axis is None
         ):
+            codec = self.wire_codec or None
             if self.mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
-                pb = pack_ragged_group(parts)
+                pb = pack_ragged_group(parts, codec=codec)
                 return PackedBatch(
                     jax.device_put(
                         pb.buffer,
@@ -401,7 +408,7 @@ class TenantStackModel:
                     ),
                     pb.layout,
                 )
-            return pack_ragged_group(parts)
+            return pack_ragged_group(parts, codec=codec)
         return stack_batches(parts)
 
     def _prepare_part(self, part):
@@ -538,6 +545,9 @@ class TenantStackModel:
                 if getattr(conf, "effective_wire_pack", lambda: "stacked")()
                 == "group" and conf.effective_wire() == "ragged"
                 else "stacked"
+            ),
+            wire_codec=(
+                getattr(conf, "effective_wire_codec", lambda: "off")()
             ),
             mesh=mesh,
             quality=getattr(conf, "modelWatch", "off") == "on",

@@ -46,6 +46,10 @@
       String(health.transitions || 0);
     document.getElementById("wireMb").textContent =
       (Number(counters["wire.bytes"] || 0) / 1e6).toFixed(1);
+    // compressed-wire ratio (--wireCodec): raw/compressed units bytes of
+    // the latest packed batch; 1.00 = codec off or shipping raw
+    document.getElementById("wireRatio").textContent =
+      (Number(gauges["wire.codec_ratio"] || 1)).toFixed(2);
     document.getElementById("rssMb").textContent =
       String(gauges["host.rss_mb"] || 0);
     document.getElementById("fetchDepth").textContent =
