@@ -38,6 +38,93 @@ def main() -> None:
         int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
     )
     mesh_kind = sys.argv[5] if len(sys.argv) > 5 else "1d"
+    if mesh_kind == "elastic_count":
+        # ISSUE 13 acceptance (the PR 1/5 law re-asserted for the
+        # membership plane): a REAL two-process lockstep run with the
+        # elastic membership plane ACTIVE and membership columns riding
+        # every tick. The cadence allgather count must equal the tick
+        # count (the columns widened the payload, never the call count)
+        # and jax.device_get must fire once per dispatched batch (zero
+        # added host fetches). Formation goes through the ElasticRuntime
+        # itself, so the counted run exercises the real detection-disabled
+        # clients — not a stand-in.
+        import jax.experimental.multihost_utils as mh
+
+        from twtml_tpu.apps.common import FetchPipeline
+        from twtml_tpu.features.featurizer import Featurizer
+        from twtml_tpu.models import StreamingLinearRegressionWithSGD
+        from twtml_tpu.parallel import elastic as _elastic
+        from twtml_tpu.streaming.context import StreamingContext
+        from twtml_tpu.streaming.membership import MembershipPlane
+        from twtml_tpu.streaming.sources import ShardedSource, SyntheticSource
+        from twtml_tpu.telemetry import metrics as _metrics
+
+        runtime = _elastic.install_runtime("127.0.0.1", port, pid)
+        runtime.form(0, list(range(nprocs)))
+
+        counts = {"allgather": 0, "get": 0}
+        real_ag = mh.process_allgather
+
+        def counting_ag(arr, **kw):
+            counts["allgather"] += 1
+            return real_ag(arr, **kw)
+
+        mh.process_allgather = counting_ag
+        real_get = jax.device_get
+
+        def counting_get(x):
+            counts["get"] += 1
+            return real_get(x)
+
+        jax.device_get = counting_get
+
+        model = StreamingLinearRegressionWithSGD(
+            num_iterations=5, step_size=0.005
+        )
+        ssc = StreamingContext(batch_interval=0)
+        stream = ssc.source_stream(
+            ShardedSource(
+                SyntheticSource(total=192, seed=7, base_ms=1785320000000),
+                pid, nprocs,
+            ),
+            Featurizer(now_ms=1785320000000),
+            row_bucket=16, token_bucket=64, row_multiple=2,
+            device_hash=True,
+        )
+        transitions: list = []
+        ssc.membership = MembershipPlane(
+            runtime,
+            lambda clean: transitions.append(("detach", clean)),
+            lambda plan, reason: transitions.append(("attach", reason)),
+        )
+        pipe = FetchPipeline(
+            model, lambda out, b, t, at_boundary: None, deterministic=True,
+        )
+        stream.foreach_batch(pipe.on_batch)
+        ssc.start(lockstep=True)
+        terminated = ssc.await_termination(timeout=120)
+        ssc.stop()
+        pipe.flush()
+        reg = _metrics.get_registry().snapshot()
+        print(json.dumps({
+            "process": pid,
+            "terminated": bool(terminated),
+            "failed": bool(ssc.failed),
+            "batches": int(ssc.batches_processed),
+            "ticks": int(reg["counters"].get("lockstep.ticks", 0)),
+            "allgathers": counts["allgather"],
+            "device_gets": counts["get"],
+            "fetch_count": int(reg["counters"].get("fetch.count", 0)),
+            "epoch": runtime.epoch,
+            "members": runtime.members,
+            "transitions": transitions,
+        }), flush=True)
+        sys.stdout.flush()
+        # elastic processes always leave hard (parallel/elastic.py): the
+        # custom clients never run the shutdown barrier, so interpreter
+        # teardown could trip the leaked-service poll FATAL
+        runtime.finalize_exit(0)
+        return
     jax.distributed.initialize(
         f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid
     )

@@ -1,0 +1,214 @@
+"""Unit tests for the elastic membership protocol machine (r16, ISSUE 13).
+
+The plane is driven here as PURE protocol — synthetic gathered matrices in,
+action strings out; no jax, no sockets, no processes. The wire-level truth
+(real gloo groups shrinking and re-growing) lives in
+tests/test_elastic_multiprocess.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from twtml_tpu.parallel.elastic import mask_from_uids, uids_from_mask
+from twtml_tpu.streaming import membership as ms
+from twtml_tpu.telemetry import sideband as _sideband
+
+
+class _StubRuntime:
+    """Duck-typed ElasticRuntime: the plane reads uid/epoch/members and the
+    (absent) beacon; the attach callback mutates epoch/members like a real
+    re-formation would."""
+
+    def __init__(self, uid: int, epoch: int = 0, members=(0, 1, 2)):
+        self.uid = uid
+        self.epoch = epoch
+        self.members = list(members)
+        self.beacon = None
+
+
+def _plane(uid, transitions, members=(0, 1, 2), **kw):
+    rt = _StubRuntime(uid, members=members)
+
+    def detach(clean):
+        transitions.append((uid, "detach", clean))
+
+    def attach(plan, reason):
+        transitions.append((uid, "attach", plan["epoch"], reason))
+        rt.epoch = plan["epoch"]
+        rt.members = list(plan["members"])
+
+    return ms.MembershipPlane(rt, detach, attach, **kw)
+
+
+def teardown_function(_fn):
+    _sideband.reset_for_tests()
+
+
+def test_view_mask_roundtrip_and_ceiling():
+    assert uids_from_mask(mask_from_uids([0, 1, 5])) == [0, 1, 5]
+    assert uids_from_mask(0) == []
+    assert mask_from_uids([]) == 0
+    # float64 int-exactness bounds the encoding at 52 hosts
+    with pytest.raises(ValueError):
+        mask_from_uids([52])
+
+
+def test_steady_state_columns_are_inert():
+    transitions: list = []
+    planes = [_plane(u, transitions) for u in range(3)]
+    rows = np.stack([p.pre_tick() for p in planes]).astype(np.int64)
+    # no proposal anywhere: every ingest is a no-op on every host
+    for p in planes:
+        assert p.ingest(rows) == ""
+    assert transitions == []
+    # the published columns carry the agreed view
+    for u, p in enumerate(planes):
+        col = p.pre_tick()
+        assert int(col[ms.FIELDS.index("uid")]) == u
+        assert int(col[ms.FIELDS.index("view")]) == mask_from_uids([0, 1, 2])
+        assert int(col[ms.FIELDS.index("prop_epoch")]) == 0
+
+
+def test_straggler_eviction_two_tick_dance_commits_simultaneously():
+    """The full in-band protocol: the sideband names host 1 (pid 1) as
+    persistently gating → the lead proposes at tick T, every member acks
+    at T+1, and the SAME gathered matrix makes every survivor reform and
+    the evictee park."""
+    transitions: list = []
+    planes = [
+        _plane(u, transitions, evict_ticks=2, evict_skew_ms=100.0)
+        for u in range(3)
+    ]
+    _sideband.publish_hosts(
+        {"hosts": [], "straggler": 1, "stage": "upload", "skew_ms": 400.0}
+    )
+    # tick 1: first gating observation — below the 2-tick bar, no proposal
+    rows = np.stack([p.pre_tick() for p in planes]).astype(np.int64)
+    assert int(rows[0, ms.FIELDS.index("prop_epoch")]) == 0
+    for p in planes:
+        assert p.ingest(rows) == ""
+    # tick 2: second consecutive observation — the lead proposes epoch 1
+    # without uid 1 and trivially acks its own proposal
+    rows = np.stack([p.pre_tick() for p in planes]).astype(np.int64)
+    assert int(rows[0, ms.FIELDS.index("prop_epoch")]) == 1
+    assert uids_from_mask(int(rows[0, ms.FIELDS.index("prop_view")])) == [0, 2]
+    assert int(rows[0, ms.FIELDS.index("ack")]) == 1
+    # followers see it in this gather; they ack from the NEXT tick
+    for p in planes:
+        assert p.ingest(rows) == ""
+    # tick 3: every row acks → commit, evaluated identically everywhere
+    rows = np.stack([p.pre_tick() for p in planes]).astype(np.int64)
+    assert (rows[:, ms.FIELDS.index("ack")] == 1).all()
+    actions = [p.ingest(rows) for p in planes]
+    assert actions == ["reform", "parked", "reform"]
+    # survivors execute the committed plan (detach clean, attach epoch 1)
+    for p in (planes[0], planes[2]):
+        p.execute_reform()
+    assert (0, "detach", True) in transitions
+    assert (0, "attach", 1, "evict") in transitions
+    assert (2, "attach", 1, "evict") in transitions
+    assert planes[0].members == [0, 2]
+
+
+def test_lead_is_never_self_evicted():
+    transitions: list = []
+    lead = _plane(0, transitions, evict_ticks=1, evict_skew_ms=100.0)
+    _sideband.publish_hosts(
+        {"hosts": [], "straggler": 0, "stage": "fetch", "skew_ms": 900.0}
+    )
+    rows = lead.pre_tick()[None, :].astype(np.int64)
+    assert int(rows[0, ms.FIELDS.index("prop_epoch")]) == 0
+    assert lead.ingest(rows) == ""
+
+
+def test_eviction_requires_consecutive_ticks():
+    transitions: list = []
+    lead = _plane(0, transitions, evict_ticks=3, evict_skew_ms=100.0)
+    for straggler in (1, 2, 1):  # alternating hosts reset the run
+        _sideband.publish_hosts(
+            {"hosts": [], "straggler": straggler, "stage": "upload",
+             "skew_ms": 500.0}
+        )
+        cols = lead.pre_tick()
+        assert int(cols[ms.FIELDS.index("prop_epoch")]) == 0
+
+
+def test_low_skew_never_proposes():
+    transitions: list = []
+    lead = _plane(0, transitions, evict_ticks=1, evict_skew_ms=250.0)
+    _sideband.publish_hosts(
+        {"hosts": [], "straggler": 1, "stage": "upload", "skew_ms": 50.0}
+    )
+    cols = lead.pre_tick()
+    assert int(cols[ms.FIELDS.index("prop_epoch")]) == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos grammar: peer.kill / peer.pause (streaming/faults.py)
+
+from twtml_tpu.streaming.faults import (  # noqa: E402
+    PEER_KILL_EXIT_CODE,
+    ChaosInjector,
+)
+
+
+def test_peer_chaos_grammar_parses():
+    inj = ChaosInjector("peer.kill:tick=7")
+    (rule,) = inj._rules["peer.kill"]
+    assert rule.kind == "kill" and int(rule.value) == 7
+    inj = ChaosInjector("peer.pause:ticks=3@5")
+    (rule,) = inj._rules["peer.pause"]
+    assert rule.kind == "pause" and int(rule.value) == 3
+    assert rule.mode == "every" and int(rule.param) == 5
+    # defaults: kill at tick 1; pause for the documented default ticks
+    assert int(ChaosInjector("peer.kill")._rules["peer.kill"][0].value) == 1
+    assert "tick" in repr(ChaosInjector("peer.kill:tick=2")._rules["peer.kill"][0])
+
+
+@pytest.mark.parametrize("bad", [
+    "peer.kill:ticks=3",        # kill takes tick=, not ticks=
+    "peer.kill:tick=0",
+    "peer.pause:tick=3",        # pause takes ticks=
+    "peer.pause:ticks=0",
+    "peer.kill:delay=2",
+])
+def test_peer_chaos_grammar_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        ChaosInjector(bad)
+
+
+def test_peer_pause_sleeps_at_its_trigger(monkeypatch):
+    import twtml_tpu.streaming.faults as faults
+
+    naps: list = []
+    monkeypatch.setattr(faults.time, "sleep", lambda s: naps.append(s))
+    inj = ChaosInjector("peer.pause:ticks=4@3")
+    for tick in (1, 2):
+        inj.peer_chaos(tick, 0.0)
+    assert naps == []
+    inj.peer_chaos(3, 0.0)
+    # back-to-back interval floors at 0.5 s per tick of pause
+    assert naps == [pytest.approx(2.0)]
+
+
+def test_peer_kill_exit_code_is_distinct():
+    # 77 collides with neither clean failures (1), SIGABRT (-6/134), nor
+    # SIGKILL (-9/137) — the elastic tests key on it
+    assert PEER_KILL_EXIT_CODE == 77
+
+
+def test_config_elastic_flags_parse():
+    from twtml_tpu.config import ConfArguments
+
+    conf = ConfArguments().parse([
+        "--elastic", "on", "--elasticEvictTicks", "4",
+        "--elasticEvictSkewMs", "300", "--elasticRejoin", "off",
+    ])
+    assert conf.elastic == "on"
+    assert conf.elasticEvictTicks == 4
+    assert conf.elasticEvictSkewMs == 300.0
+    assert conf.elasticRejoin == "off"
+    with pytest.raises(SystemExit):
+        ConfArguments().parse(["--elastic", "maybe"])

@@ -47,16 +47,121 @@ def init_distributed(conf) -> bool:
     ``parallel.distributed.initialize`` — which MUST happen before anything
     initializes the XLA backend, so apps call this first.
 
+    ``--elastic on`` routes group formation through the elastic runtime
+    instead (parallel/elastic.py): epoch-addressed custom clients whose
+    dead-peer reaction is OURS (the lockstep watchdog + membership plane),
+    not the coordination service's process-kill. A RESTARTED host finds a
+    live run via the lead's beacon and parks for admission at the next
+    epoch boundary — rejoining a mid-flight fleet with the same CLI that
+    launched it.
+
     Returns True when this process should own telemetry/prints (the lead —
     process 0, or any single-host run)."""
     conf.validate_master()
     mh = conf.multihost()
     if mh is None:
         return True
+    if conf.backend == "cpu":
+        # cross-process CPU collectives need gloo selected BEFORE the
+        # backend initializes (jax 0.4.x wires it to the distributed
+        # client at backend creation) — and the jax.process_index() probe
+        # at the end of THIS function is the first backend init. Without
+        # this, the documented multi-host CLI dies at its first
+        # collective with "Multiprocess computations aren't implemented
+        # on the CPU backend" (the test harness had set the flag by hand
+        # since PR 1, which is why only raw CLI runs ever hit it).
+        import jax
+
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    coordinator, num_processes, process_id = mh
+    if getattr(conf, "elastic", "off") == "on":
+        return _init_elastic(conf, coordinator, num_processes, process_id)
     from ..parallel.distributed import initialize
 
-    coordinator, num_processes, process_id = mh
     initialize(coordinator, num_processes, process_id)
+    import jax
+
+    return jax.process_index() == 0
+
+
+def _init_elastic(conf, coordinator: str, num_processes: int,
+                  process_id: int) -> bool:
+    """Elastic group formation. Cold start: everyone forms epoch 0 with
+    the full launch membership. A restarted host (the run is already live
+    and this uid is not — or no longer — a member) parks at the beacon
+    and joins at the epoch boundary the lead commits for it."""
+    import os as _os
+    import time as _time
+
+    from ..parallel import elastic as _elastic
+
+    if num_processes is None or process_id is None:
+        raise SystemExit(
+            "--elastic on needs explicit --numProcesses/--processId (or a "
+            "twtml:// master with both): elastic membership has no "
+            "cluster-env auto-detection"
+        )
+    host, _, port = coordinator.rpartition(":")
+    runtime = _elastic.install_runtime(
+        host or "127.0.0.1", int(port), process_id
+    )
+    launch_members = list(range(num_processes))
+    if not getattr(conf, "checkpointDir", ""):
+        log.warning(
+            "--elastic on without --checkpointDir: membership changes "
+            "re-synchronize from the lead's LIVE state instead of a "
+            "verified on-disk checkpoint (reduced rollback guarantee)"
+        )
+    if process_id == 0:
+        runtime.beacon.publish("forming", 0, launch_members)
+        runtime.form(0, launch_members)
+        import jax
+
+        return jax.process_index() == 0
+    client = runtime.beacon_client()
+    deadline = _time.monotonic() + _elastic._init_timeout_s()
+    hello = None
+    while _time.monotonic() < deadline:
+        hello = client.request("hello", process_id)
+        if hello is not None:
+            break
+        _time.sleep(0.5)
+    if hello is None:
+        raise SystemExit(
+            f"--elastic on: the lead's membership beacon at "
+            f"{host}:{runtime.beacon_port} never answered — is the lead up?"
+        )
+    if hello["state"] == "forming":
+        runtime.form(0, launch_members)
+    else:
+        # live run: this is a RESTARTED host — park for admission
+        log.warning(
+            "elastic: run already live at epoch %d (members %s); parking "
+            "this host (uid %d) for admission at the next epoch boundary",
+            hello["epoch"], hello["members"], process_id,
+        )
+        joined = False
+        park_deadline = _time.monotonic() + float(
+            _os.environ.get("TWTML_ELASTIC_PARK_TIMEOUT_S", "") or 120.0
+        )
+        while _time.monotonic() < park_deadline:
+            client.request("join", process_id)
+            state = client.request("hello", process_id) or {}
+            plan = (client.request("plan", process_id) or {}).get("plan")
+            if plan and process_id in plan.get("members", []) and (
+                plan["epoch"] > state.get("epoch", -1)
+            ):
+                runtime.joined_late = True
+                runtime.form(plan["epoch"], plan["members"])
+                joined = True
+                break
+            _time.sleep(0.5)
+        if not joined:
+            raise SystemExit(
+                "elastic: admission never committed within the park "
+                "window (is --elasticRejoin off on the lead, or the "
+                "group idle?)"
+            )
     import jax
 
     return jax.process_index() == 0
@@ -367,12 +472,6 @@ def build_model(conf, model_cls=StreamingLinearRegressionWithSGD):
 
     force_plane = _os.environ.get("TWTML_FORCE_TENANT_PLANE") == "1"
     if tenants > 1 or (force_plane and tenants == 1):
-        if _jax.process_count() > 1:
-            raise SystemExit(
-                "--tenants is single-host at the app level for now; the "
-                "cross-process tenants-on-model-axis layout is a library "
-                "surface (parallel/tenants.TenantStackModel with a 2D mesh)"
-            )
         if getattr(conf, "tenantKey", "hash") == "lang" and conf.hashOn != "device":
             raise SystemExit(
                 "--tenantKey lang routes on raw code units; it requires "
@@ -380,6 +479,44 @@ def build_model(conf, model_cls=StreamingLinearRegressionWithSGD):
             )
         from ..parallel.tenants import TenantStackModel
 
+        if _jax.process_count() > 1:
+            # app-level tenant fleet (r16, PR 7 REMAINING b): the tenant
+            # stack behind per-host sharded intake on the 1D process-
+            # aligned data mesh — the global tenant wire assembles on the
+            # row axis like the stacked superbatch wire, ONE pooled fetch
+            # per tick, and the elastic membership plane rebuilds it
+            # across epochs like the single-model plane
+            if conf.effective_wire() == "ragged":
+                raise SystemExit(
+                    "--tenants on multi-host ships the stacked tenant "
+                    "wire (padded or unit); the ragged tenant split would "
+                    "need per-tenant cross-host bucket agreement — use "
+                    "--wire padded"
+                )
+            from ..parallel.tenants import MultiHostTenantModel
+
+            mesh = build_mesh(
+                conf, what=f"tenant fleet ({model_cls.__name__})"
+            )
+
+            def tenant_rebuilder(new_mesh):
+                return TenantStackModel.from_conf(
+                    conf, new_mesh,
+                    residual_fn=model_cls.residual_fn,
+                    prediction_fn=model_cls.prediction_fn,
+                    round_predictions=model_cls.round_predictions,
+                )
+
+            inner = tenant_rebuilder(mesh)
+            model = MultiHostTenantModel(
+                inner, mesh, rebuilder=tenant_rebuilder
+            )
+            log.info(
+                "multi-tenant model FLEET: %d tenants across %d hosts, "
+                "key=%s, stacked wire", tenants, _jax.process_count(),
+                model.tenant_key,
+            )
+            return model, max(1, inner.num_data // _jax.process_count())
         mesh = build_mesh(conf, what=f"tenant plane ({model_cls.__name__})")
         model = TenantStackModel.from_conf(
             conf, mesh,
@@ -397,34 +534,49 @@ def build_model(conf, model_cls=StreamingLinearRegressionWithSGD):
     if mesh is not None:
         from ..parallel import ParallelSGDModel
 
-        model = ParallelSGDModel.from_conf(
-            conf, mesh,
-            residual_fn=model_cls.residual_fn,
-            prediction_fn=model_cls.prediction_fn,
-            round_predictions=model_cls.round_predictions,
-        )
+        def sgd_rebuilder(new_mesh):
+            if new_mesh is None:
+                # an elastic fleet shrunk to one host with one device:
+                # build_mesh legitimately says "unsharded", but the
+                # MultiHost wrapper's step/pack surface needs A mesh — a
+                # 1-device data mesh is the same math (shard_map over one
+                # shard) and keeps every holder of the wrapper working
+                import jax as _jax_inner
+
+                from ..parallel import make_mesh
+
+                new_mesh = make_mesh(
+                    num_data=1, devices=_jax_inner.devices()[:1]
+                )
+            return ParallelSGDModel.from_conf(
+                conf, new_mesh,
+                residual_fn=model_cls.residual_fn,
+                prediction_fn=model_cls.prediction_fn,
+                round_predictions=model_cls.round_predictions,
+            )
+
+        model = sgd_rebuilder(mesh)
         import jax
 
         if jax.process_count() > 1:
-            if codec == "dict":
-                # the global wire assembly needs uniform per-segment bytes
-                # on every process; a cross-host agreed COMPRESSED bucket
-                # would add a collective to the lockstep tick (see
-                # parallel/distributed.py) — reject rather than silently
-                # shipping raw
+            if codec == "dict" and int(getattr(conf, "superBatch", 1) or 1) > 1:
+                # the coalesced K-group wire would need the agreed codec
+                # bucket across all K batches before the first of them is
+                # known — the k=1 flat wire is the multi-host codec form
                 raise SystemExit(
-                    "--wireCodec dict is single-host for now (the "
-                    "multi-host packed wire needs a cross-host agreed "
-                    "compressed bucket)"
+                    "--wireCodec dict on multi-host is k=1 only for now: "
+                    "drop --superBatch (the compressed bucket agreement "
+                    "rides the per-batch alignment allgather)"
                 )
             from ..parallel.distributed import MultiHostSGDModel
 
             # the app featurizes only THIS host's rows: its local batch
-            # must divide this host's share of the data axis
-            return (
-                MultiHostSGDModel(model, mesh),
-                max(1, model.num_data // jax.process_count()),
-            )
+            # must divide this host's share of the data axis. The codec
+            # bucket (r16) is agreed on the SAME pack-time alignment
+            # allgather the raw bucket already pays — zero new collectives.
+            mh = MultiHostSGDModel(model, mesh, rebuilder=sgd_rebuilder)
+            mh.wire_codec = codec if codec == "dict" else ""
+            return mh, max(1, model.num_data // jax.process_count())
         # single-process mesh: the mesh packs compress per shard segment
         # (parallel/sharding.py pack_for_wire / pack_group_for_wire)
         model.wire_codec = codec if codec == "dict" else ""
@@ -520,6 +672,14 @@ class AppCheckpoint:
                     "resumed from the lead's broadcast checkpoint "
                     "(count=%s)", totals["count"],
                 )
+            # every host logs the post-broadcast crc: an elastic rejoiner's
+            # first-tick weights must BIT-match the lead's, and matching
+            # crc lines across hosts are the assertable proof
+            log.info(
+                "multi-host state synchronized from the lead (count=%s, "
+                "state crc %s)", totals["count"],
+                state_checksum(self._get_state()),
+            )
         self._last = totals["batches"]
 
     def _save(self, totals: dict) -> None:
@@ -560,6 +720,72 @@ class AppCheckpoint:
         if self._ckpt is None:
             return False
         self._save(totals)
+        return True
+
+    def resync_from_verified(self, totals: dict) -> bool:
+        """Elastic epoch re-synchronization (r16): every member of a
+        just-formed epoch converges on the LEAD's state + counters — its
+        newest verified on-disk checkpoint when one exists (the documented
+        rollback guarantee: a clean commit saves at the boundary first, so
+        it loses nothing; a rescue rolls back at most --checkpointEvery
+        batches), else its live weights (checkpoints off — survivors are
+        psum-identical anyway, and a joiner still inherits the truth).
+        Rolled-back rows are counted (``elastic.rows_rolled_back``), never
+        silent. Single-process epochs (a fleet shrunk to one host) restore
+        locally with no collective. Returns False only when there is
+        neither a checkpoint nor a multi-host broadcast to sync from (the
+        degenerate 1-host/no-disk case — state simply continues)."""
+        import jax
+
+        restored = (
+            self._ckpt.restore()
+            if self._ckpt is not None and self._lead else None
+        )
+        old_count = int(totals.get("count", 0))
+
+        def adopt(state, count, batches) -> None:
+            self._set_state(state)
+            totals["count"] = int(count)
+            totals["batches"] = int(batches)
+            self._last = totals["batches"]
+            rolled = max(0, old_count - totals["count"])
+            if rolled:
+                _metrics.get_registry().counter(
+                    "elastic.rows_rolled_back"
+                ).inc(rolled)
+            log.warning(
+                "elastic resync: state from the lead's %s (count=%d, "
+                "batches=%d, state crc %s)%s",
+                "verified checkpoint" if restored is not None or not (
+                    self._lead
+                ) else "live weights",
+                totals["count"], totals["batches"], state_checksum(state),
+                f" — {rolled} row(s) of post-checkpoint progress rolled "
+                f"back (counted)" if rolled else "",
+            )
+
+        if jax.process_count() <= 1:
+            if restored is None:
+                return False
+            state, meta = restored
+            adopt(state, meta.get("count", 0), meta.get("batches", 0))
+            return True
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        state = self._get_state()
+        count, batches = totals.get("count", 0), totals.get("batches", 0)
+        if restored is not None:
+            state = restored[0]
+            count = restored[1].get("count", 0)
+            batches = restored[1].get("batches", 0)
+        meta_arr, state = multihost_utils.broadcast_one_to_all((
+            np.array([1, count, batches], np.int64), state,
+        ))
+        adopt(
+            jax.tree_util.tree_map(np.asarray, state),
+            int(meta_arr[1]), int(meta_arr[2]),
+        )
         return True
 
     def rollback_to_verified(self) -> "dict | None":
@@ -1347,6 +1573,13 @@ class SuperBatcher:
         while self._inflight:
             self._emit_group()
 
+    def drain(self) -> None:
+        """Deliver every in-flight group NOW without dispatching more —
+        the elastic membership plane calls this before a group re-forms
+        (nothing may stay in flight across a backend rebuild; buffered
+        undispatched batches are host-side and survive untouched)."""
+        self._drain()
+
     def _coalesce(self, batch) -> bool:
         """Whether this batch rides the coalesced one-buffer wire (group
         mode, ragged wire, and a model whose jit program unpacks it)."""
@@ -1729,6 +1962,12 @@ class FetchPipeline:
         self._dispatched -= 1
         self._refund_count.inc()
 
+    def drain(self) -> None:
+        """Deliver every pending output NOW without dispatching more —
+        the elastic membership plane calls this before a group re-forms
+        (nothing may stay in flight across a backend rebuild)."""
+        self._drain()
+
     @property
     def pending_fetches(self) -> int:
         """In-flight pooled fetches (the serving plane's idle loop reads
@@ -1785,9 +2024,175 @@ class FetchPipeline:
             self._pool.shutdown(wait=False)
 
 
+def _rebalance_intake(source, old_members, new_members, my_uid: int,
+                      reason: str) -> None:
+    """Intake rebalance across an elastic membership change. Departed
+    hosts' residue classes are adopted round-robin by survivors (exact
+    going-forward coverage — streaming/sources.py); a REJOINED host's
+    handling is source-kind-aware: live id-sharded streams hand its
+    residues back (ids are position-free), replay index shards keep them
+    with the adopters (the rejoiner becomes a hot standby — re-reading its
+    file shard from zero would double-train). Sources with no residue
+    surface (block byte-range shards) lose the departed range, counted."""
+    sharded = source
+    while sharded is not None and not hasattr(sharded, "adopt_residues"):
+        sharded = getattr(sharded, "inner", None)
+    departed = sorted(u for u in old_members if u not in new_members)
+    rejoined = sorted(u for u in new_members if u not in old_members)
+    reg = _metrics.get_registry()
+    if sharded is None:
+        if departed:
+            reg.counter("elastic.shards_lost").inc(len(departed))
+            log.warning(
+                "elastic: this source kind cannot adopt departed shard(s) "
+                "%s — their remaining rows are lost (counted in "
+                "elastic.shards_lost)", departed,
+            )
+        return
+    survivors = sorted(u for u in new_members if u in old_members)
+    for i, uid in enumerate(departed):
+        owner = survivors[i % len(survivors)] if survivors else -1
+        if owner == my_uid:
+            sharded.adopt_residues([uid])
+    from ..streaming.sources import IdShardedSource
+
+    if rejoined and isinstance(sharded, IdShardedSource):
+        # live stream: the rejoiner's fresh connection resumes its id
+        # residues from now — adopters release them (position-free keys)
+        sharded.release_residues(rejoined)
+    if my_uid in rejoined and not isinstance(sharded, IdShardedSource):
+        # replay standby: contribute all-padding batches; the adopters own
+        # the residues and the weights stay bit-synchronized regardless
+        sharded.residues.clear()
+        log.warning(
+            "elastic: rejoined a replay-sharded run as a hot standby "
+            "(index shards are position-bound; residues stay with their "
+            "adopters)"
+        )
+
+
+def attach_elastic(conf, ssc, model, stream, ckpt, totals):
+    """``--elastic on`` wiring: build the membership plane over the
+    elastic runtime formed in ``init_distributed`` and install it on the
+    streaming context. The two transition callbacks close over the whole
+    app stack so a membership change is a full re-provisioning:
+
+    detach — drain the fetch pipeline (nothing in flight across a backend
+    rebuild), on a CLEAN commit checkpoint at the boundary (loss-free),
+    then abandon the epoch's process group;
+
+    attach — form the new epoch, rebuild the mesh + model in place,
+    re-synchronize state/counters from the lead (broadcast of its verified
+    checkpoint — the PR 4 path), rebalance intake shards across the new
+    membership, and pre-compile the step for the new world so the first
+    post-reform tick doesn't stall.
+
+    Returns the plane (or None when the run is not elastic); pass it to
+    ``attach_super_batcher`` so the pipeline drain hook binds."""
+    import jax
+
+    from ..parallel import elastic as _elastic
+    from ..streaming.membership import MembershipPlane
+
+    runtime = _elastic.get_runtime()
+    if runtime is None or jax.process_count() <= 1:
+        return None
+    source = ssc._source
+    if runtime.joined_late:
+        # a restarted host admitted into a LIVE run: its replay-index
+        # residues were adopted by the incumbents when it departed —
+        # re-reading its file shard from zero would double-train, so it
+        # contributes as a hot standby (live id-sharded sources keep their
+        # residues: the incumbents release them, _rebalance_intake)
+        from ..streaming.sources import IdShardedSource
+
+        sharded = source
+        while sharded is not None and not hasattr(sharded, "adopt_residues"):
+            sharded = getattr(sharded, "inner", None)
+        if sharded is not None and not isinstance(sharded, IdShardedSource):
+            sharded.residues.clear()
+            log.warning(
+                "elastic: joined a live replay-sharded run as a hot "
+                "standby (residues stay with their adopters)"
+            )
+    st: dict = {
+        "pipeline": None, "group_k": 1,
+        "old_members": list(runtime.members),
+    }
+
+    def detach(clean: bool) -> None:
+        st["old_members"] = list(runtime.members)
+        pipe = st.get("pipeline")
+        if pipe is not None:
+            pipe.drain()
+        if clean:
+            # every member is alive and synchronized at a clean commit
+            # tick: the lead snapshots HERE so the resync after formation
+            # restores exactly the pre-transition state — zero loss
+            ckpt.save_now(totals)
+        runtime.abandon()
+
+    def attach(plan: dict, reason: str) -> None:
+        runtime.form(plan["epoch"], plan["members"])
+        mesh = build_mesh(conf, what=f"elastic epoch {plan['epoch']}")
+        model.rebuild(mesh)
+        if reason == "rejoin":
+            # a rejoiner's queued rows predate its absence; the adopters
+            # own that coverage now — training them would double-train
+            dropped = sum(
+                getattr(s, "rows", 1) for s in ssc._drain(0)
+            )
+            if dropped:
+                _metrics.get_registry().counter(
+                    "elastic.rows_dropped_rejoin"
+                ).inc(dropped)
+                log.warning(
+                    "elastic: dropped %d stale queued row(s) on rejoin "
+                    "(counted in elastic.rows_dropped_rejoin)", dropped,
+                )
+        ckpt.resync_from_verified(totals)
+        _rebalance_intake(
+            source, st["old_members"], plan["members"], runtime.uid, reason,
+        )
+        warmup_compile(stream, model, super_batch=st["group_k"])
+
+    plane = MembershipPlane(
+        runtime, detach, attach,
+        evict_ticks=int(getattr(conf, "elasticEvictTicks", 0) or 0),
+        evict_skew_ms=float(getattr(conf, "elasticEvictSkewMs", 250.0)),
+        rejoin=getattr(conf, "elasticRejoin", "on") == "on",
+    )
+    plane._bind_box = st  # attach_super_batcher fills st["pipeline"]
+    ssc.membership = plane
+    log.info(
+        "elastic membership plane ACTIVE: epoch %d, members %s, "
+        "evict after %s gating tick(s), rejoin %s",
+        runtime.epoch, runtime.members,
+        plane.evict_ticks or "∞", "on" if plane.rejoin else "off",
+    )
+    return plane
+
+
+def elastic_exit(failed: bool = False) -> None:
+    """Elastic runs must leave via a hard exit (abandoned-epoch teardown
+    during interpreter finalization LOG(FATAL)s — parallel/elastic.py);
+    no-op without an elastic runtime. Call as the LAST line of an app's
+    run path, after checkpoints and telemetry have flushed."""
+    from ..parallel import elastic as _elastic
+
+    runtime = _elastic.get_runtime()
+    if runtime is None:
+        return
+    log.info(
+        "elastic run complete (epoch %d, %d reform(s)); hard exit %d",
+        runtime.epoch, len(runtime._graveyard), 1 if failed else 0,
+    )
+    runtime.finalize_exit(1 if failed else 0)
+
+
 def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
                          max_dispatch: int = 0, abort=None, sentinel=None,
-                         modelwatch=None):
+                         modelwatch=None, elastic=None):
     """Wire the app's per-batch ``handle(out, batch, t, at_boundary)`` to the
     stream: plain step-then-handle by default, grouped through a
     SuperBatcher when ``--superBatch K`` applies. Returns
@@ -1905,6 +2310,8 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
             "--tokenBucket (every host must dispatch the same collective "
             "program every tick, including all-padding batches)"
         )
+    if elastic is not None:
+        elastic._bind_box["group_k"] = k  # reform warmup re-compiles k too
 
     def skip_empty(fn):
         if multihost:
@@ -1927,11 +2334,15 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
         # not surface to the app — single-host runs skip those pre-step.
         # It must not consume a max-batches slot either (refund below, set
         # once the pipeline exists).
+        import numpy as _np
+
         inner_handle = handle
         pipeline_ref: list = []
 
         def handle(out, batch, t, at_boundary=True):  # noqa: F811
-            if int(out.count) == 0:
+            # the tenant fleet delivers an [M]-stacked count; a batch is
+            # globally empty only when EVERY tenant's share is
+            if int(_np.asarray(out.count).sum()) == 0:
                 log.debug("batch: 0 (global)")
                 if pipeline_ref:
                     pipeline_ref[0].refund_dispatch()
@@ -1994,6 +2405,8 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
                 pipeline_ref.append(pipe)  # empty-batch refunds (above)
             if sentinel is not None:
                 sentinel.bind(pipe)  # skipped batches refund their cap slot
+            if elastic is not None:
+                elastic._bind_box["pipeline"] = pipe  # reform drain hook
             stream.foreach_batch(skip_empty(pipe.on_batch))
             return pipe.flush, 1
 
@@ -2072,6 +2485,8 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
         pipeline_ref.append(batcher)  # empty-batch refunds (above)
     if sentinel is not None:
         sentinel.bind(batcher)  # skipped batches refund their cap slot
+    if elastic is not None:
+        elastic._bind_box["pipeline"] = batcher  # reform drain hook
     # grouping needs every batch in its FINAL layout before the shape
     # signature/stacking: mesh and multi-host models shard-align ragged
     # batches (and harmonize the wire dtype across hosts) in prepare()

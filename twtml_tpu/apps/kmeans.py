@@ -97,6 +97,15 @@ def featurize(status: Status) -> np.ndarray:
 
 
 def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> dict:
+    if getattr(conf, "elastic", "off") == "on":
+        # the k-means plane's raw-stream handler owns its own global
+        # assembly; the elastic rebuild contract (model.rebuild + the
+        # broadcast resync) is wired for the SGD-family apps only
+        raise SystemExit(
+            "--elastic on is wired for the SGD entry points (linear, "
+            "logistic); the k-means plane keeps the abort-on-peer-loss "
+            "behavior for now"
+        )
     lead = init_distributed(conf)  # every entry point forms the group
     select_backend(conf)
     install_trace(conf)

@@ -146,6 +146,13 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         if max_batches and totals["batches"] >= max_batches:
             ssc.request_stop()
 
+    # elastic membership plane (--elastic on): host loss degrades capacity
+    # instead of killing the run; a recovered host rejoins at an epoch
+    # boundary (apps/common.attach_elastic)
+    from .common import attach_elastic, elastic_exit
+
+    elastic_plane = attach_elastic(conf, ssc, model, stream, ckpt, totals)
+
     flush_group, group_k = attach_super_batcher(
         conf, stream, model, handle,
         stop_requested=lambda: ssc.stop_requested,
@@ -155,6 +162,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         abort=ssc.request_abort,  # fetch-watchdog aborts fail the run loudly
         sentinel=sentinel,
         modelwatch=modelwatch,
+        elastic=elastic_plane,
     )
 
     warmup_compile(stream, model, super_batch=group_k)
@@ -186,11 +194,15 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         pipeline_trace.uninstall()  # flush + close the --trace file
         ckpt.final_save(totals)
     if ssc.failed:
+        # elastic runs leave via a hard exit either way (abandoned-epoch
+        # teardown during interpreter finalization is unsafe)
+        elastic_exit(failed=True)
         raise RuntimeError(
             "run aborted by a runtime guard — lockstep peer loss, a fetch "
             "watchdog abort, or the divergence sentinel (see critical log "
             "above); progress up to the failure is checkpointed"
         )
+    elastic_exit(failed=False)
     return totals
 
 

@@ -169,6 +169,24 @@ class ConfArguments:
                 f"{self.wireCodec!r}"
             )
         self.recycleAfterMb: int = int(conf.get("recycleAfterMb", "0"))
+        # elastic lockstep membership (r16): host loss shrinks the fleet
+        # instead of aborting it; recovered hosts rejoin at epoch
+        # boundaries (parallel/elastic.py + streaming/membership.py)
+        self.elastic: str = conf.get("elastic", "off")
+        if self.elastic not in ("off", "on"):
+            raise ValueError(
+                f"elastic must be 'off' or 'on', got {self.elastic!r}"
+            )
+        self.elasticEvictTicks: int = int(conf.get("elasticEvictTicks", "0"))
+        self.elasticEvictSkewMs: float = float(
+            conf.get("elasticEvictSkewMs", "250")
+        )
+        self.elasticRejoin: str = conf.get("elasticRejoin", "on")
+        if self.elasticRejoin not in ("off", "on"):
+            raise ValueError(
+                "elasticRejoin must be 'off' or 'on', got "
+                f"{self.elasticRejoin!r}"
+            )
         # multi-tenant model plane (r10): M models, one jit program, one fetch
         self.tenants: int = int(conf.get("tenants", "1"))
         if self.tenants < 1:
@@ -366,6 +384,27 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                                                dispatch (one scan, one stats fetch; per-batch
                                                stats preserved; stops/checkpoints land on group
                                                boundaries). Default: {self.superBatch}
+  --elastic <off|on>                           Elastic lockstep membership: a dead or evicted
+                                               host SHRINKS the multi-host group (survivors
+                                               re-form at an epoch boundary, restore the lead's
+                                               verified checkpoint, and adopt the departed
+                                               intake shards) instead of aborting the run; a
+                                               recovered host REJOINS at the next boundary.
+                                               SGD entry points, explicit --processId/
+                                               --numProcesses. Default: {self.elastic}
+  --elasticEvictTicks <int>                    Elastic straggler eviction: propose shrinking
+                                               out a host the sideband attributor names gating
+                                               for this many CONSECUTIVE ticks (with skew over
+                                               --elasticEvictSkewMs). 0 = never auto-evict
+                                               (watchdog-detected death still shrinks).
+                                               Default: {self.elasticEvictTicks}
+  --elasticEvictSkewMs <float>                 Minimum tick skew (ms) before a gating host
+                                               counts toward --elasticEvictTicks.
+                                               Default: {self.elasticEvictSkewMs}
+  --elasticRejoin <off|on>                     Whether the lead admits parked/restarted hosts
+                                               back at epoch boundaries (rejoiners restore the
+                                               broadcast checkpoint before their first tick).
+                                               Default: {self.elasticRejoin}
   --tenants <int M>                            Multi-tenant model plane: train M models
                                                (per-topic/per-language/per-A/B-arm) in ONE
                                                jit program — rows route to tenants on the
@@ -618,6 +657,18 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                 self.printUsage(1)
         elif flag == "--recycleAfterMb":
             self.recycleAfterMb = int(take())
+        elif flag == "--elastic":
+            self.elastic = take()
+            if self.elastic not in ("off", "on"):
+                self.printUsage(1)
+        elif flag == "--elasticEvictTicks":
+            self.elasticEvictTicks = int(take())
+        elif flag == "--elasticEvictSkewMs":
+            self.elasticEvictSkewMs = float(take())
+        elif flag == "--elasticRejoin":
+            self.elasticRejoin = take()
+            if self.elasticRejoin not in ("off", "on"):
+                self.printUsage(1)
         elif flag == "--tenants":
             self.tenants = int(take())
             if self.tenants < 1:

@@ -506,13 +506,22 @@ def _encode_units_codec(units: np.ndarray, codec: "str | None"):
 
 
 def _encode_units_segments(
-    units: np.ndarray, num_segments: int, codec: "str | None"
+    units: np.ndarray, num_segments: int, codec: "str | None",
+    bucket: "int | None" = None,
 ):
     """Per-segment digram codes [num_segments, shared bucket] for a
     SEGMENTED raw units buffer (shard sub-buffers / group segments —
     each must decode independently under its device's slice), or None →
     raw wire. The bucket is joint (max segment, rounded) so every segment
-    is the same static shape; all-or-nothing per pack."""
+    is the same static shape; all-or-nothing per pack.
+
+    ``bucket`` (r16, multi-host codec) FORCES the shared bucket to a
+    cross-host AGREED value (parallel/distributed.py
+    ``_ragged_local_aligned_codec``): every process must emit identical
+    codec segment shapes for the global wire assembly, so the local-max
+    bucket (and the local incompressibility fallback) must not decide. A
+    segment encoding past the agreed bucket is a codec-bound bug and
+    raises — silent truncation would corrupt the wire."""
     if codec is None or codec in ("", "off"):
         return None
     if codec != "dict":
@@ -524,9 +533,18 @@ def _encode_units_segments(
 
     rows = u.reshape(num_segments, -1)
     enc = [encode(r) for r in rows]
-    bucket = encoded_bucket(max(e.shape[0] for e in enc))
-    if bucket >= rows.shape[1]:
-        return None  # incompressible: the raw wire is the smaller wire
+    if bucket is None:
+        bucket = encoded_bucket(max(e.shape[0] for e in enc))
+        if bucket >= rows.shape[1]:
+            return None  # incompressible: the raw wire is the smaller wire
+    else:
+        over = max(e.shape[0] for e in enc)
+        if over > bucket:
+            raise ValueError(
+                f"agreed codec bucket {bucket} under-covers a segment "
+                f"encoding of {over} units — the cross-host zero-pad bound "
+                "is violated (codec bug)"
+            )
     out = np.zeros((num_segments, bucket), np.uint8)
     for i, e in enumerate(enc):
         out[i, : e.shape[0]] = e
@@ -589,6 +607,7 @@ def pack_ragged_sharded(
     rb: "RaggedUnitBatch", num_shards_out: int = 0,
     narrow_offsets: "bool | None" = None,
     codec: "str | None" = None,
+    codec_bucket: "int | None" = None,
 ) -> PackedBatch:
     """A SHARD-ALIGNED ragged batch → one wire buffer laid out PER SHARD, so
     a mesh data axis can shard the single buffer (r5: the +11.4% packing
@@ -633,7 +652,7 @@ def pack_ragged_sharded(
         if narrow
         else (rb.offsets, (bl + 1,))
     )
-    codes = _encode_units_segments(rb.units, s, codec)
+    codes = _encode_units_segments(rb.units, s, codec, bucket=codec_bucket)
     units_wire = (
         (rb.units, (n_sb,)) if codes is None else (codes, (codes.shape[1],))
     )

@@ -98,9 +98,6 @@ def _ragged_local_aligned(batch: RaggedUnitBatch, mesh) -> RaggedUnitBatch:
     deterministic points (the lockstep tick / dispatch path) so the
     collective always pairs."""
     import numpy as _np
-    from jax.experimental import multihost_utils
-
-    from ..features.batch import align_ragged_shards, ragged_shard_bucket
 
     if batch.units.dtype != _np.uint16:
         batch = RaggedUnitBatch(
@@ -108,24 +105,102 @@ def _ragged_local_aligned(batch: RaggedUnitBatch, mesh) -> RaggedUnitBatch:
             batch.numeric, batch.label, batch.mask,
             row_len=batch.row_len, num_shards=batch.num_shards,
         )
+    aligned, _codec = _ragged_local_aligned_codec(batch, mesh, codec="")
+    return aligned
+
+
+def _ragged_local_aligned_codec(
+    batch: RaggedUnitBatch, mesh, codec: str = ""
+) -> "tuple[RaggedUnitBatch, int]":
+    """The alignment agreement, widened for the compressed wire (r16,
+    ROADMAP item 3 REMAINING): the SAME one allgather that agrees the raw
+    per-shard bucket also carries this host's codec eligibility (uint8
+    units) and its encoded-segment maximum, so the cross-host COMPRESSED
+    bucket needs zero additional collectives. Returns ``(aligned batch,
+    agreed codec bucket)`` — 0 means the wire ships raw (codec off, a
+    non-ASCII host, or an incompressible agreement).
+
+    The agreed codec bucket must cover every host's segments AFTER
+    re-alignment to the agreed raw bucket, which each host cannot encode
+    locally (it doesn't know the agreed raw bucket yet). The bound that
+    closes the loop without a second collective: growing a segment's
+    capacity only extends its trailing zero run, and the greedy digram
+    encode maps 2k extra zeros to k extra zero-pair codes (dictionary
+    entry 0) plus at most one boundary byte — so every host derives the
+    same agreed bucket as ``max over hosts of (enc_max_h +
+    ceil((agreed_raw - raw_need_h) / 2) + 1)``, rounded to the codec
+    multiple, from the one gathered [need, enc_max, eligible] triple.
+    ``pack_ragged_sharded`` asserts the bound at encode time (a violation
+    is a codec bug, never silent wire corruption)."""
+    import numpy as _np
+    from jax.experimental import multihost_utils
+
+    from ..features.batch import align_ragged_shards, ragged_shard_bucket
+
     num_data = mesh.shape[mesh.axis_names[0]]
     local_shards = num_data // jax.process_count()
-    if batch.num_shards == local_shards > 1:
-        # already local-aligned: on the multi-host path the only producer
-        # of this layout is a prior call of this function, whose per-shard
-        # capacity IS the agreed bucket — skip the re-allgather (the
-        # superbatch partial-group step would otherwise pay one redundant
-        # DCN round trip per batch, r5 review). local_shards == 1 cannot
-        # distinguish a fresh flat batch from a prepared one, so that
-        # topology keeps the collective.
-        return batch
+    if not codec or codec == "off":
+        if batch.num_shards == local_shards > 1:
+            # already local-aligned: on the multi-host path the only
+            # producer of this layout is a prior call of this function,
+            # whose per-shard capacity IS the agreed bucket — skip the
+            # re-allgather (the superbatch partial-group step would
+            # otherwise pay one redundant DCN round trip per batch, r5
+            # review). local_shards == 1 cannot distinguish a fresh flat
+            # batch from a prepared one, so that topology keeps the
+            # collective.
+            return batch, 0
+        need = ragged_shard_bucket(batch, local_shards)
+        agreed = int(
+            multihost_utils.process_allgather(
+                _np.array([need], _np.int64)
+            ).max()
+        )
+        return align_ragged_shards(batch, local_shards, unit_bucket=agreed), 0
+
+    from ..features.wirecodec import encode, encoded_bucket
+
     need = ragged_shard_bucket(batch, local_shards)
-    agreed = int(
-        multihost_utils.process_allgather(
-            _np.array([need], _np.int64)
-        ).max()
+    eligible = int(batch.units.dtype == _np.uint8)
+    enc_max = 0
+    if eligible:
+        # encode at LOCAL alignment; the agreed bound formula below lifts
+        # it to the agreed raw bucket without re-encoding
+        local = align_ragged_shards(batch, local_shards, unit_bucket=need)
+        segs = _np.asarray(local.units).reshape(local_shards, -1)
+        enc_max = max(int(encode(r).shape[0]) for r in segs)
+    gathered = multihost_utils.process_allgather(
+        _np.array([need, enc_max, eligible], _np.int64)
     )
-    return align_ragged_shards(batch, local_shards, unit_bucket=agreed)
+    gathered = _np.atleast_2d(gathered)
+    agreed_raw = int(gathered[:, 0].max())
+    all_eligible = bool(gathered[:, 2].min())
+    aligned = align_ragged_shards(batch, local_shards, unit_bucket=agreed_raw)
+    if not all_eligible:
+        # mixed dtypes across hosts: harmonize to the full uint16 schema
+        # (the pre-codec rule) and ship raw — counted as a codec fallback
+        # at the app seam
+        if aligned.units.dtype != _np.uint16:
+            aligned = RaggedUnitBatch(
+                _np.asarray(aligned.units, _np.uint16), aligned.offsets,
+                aligned.numeric, aligned.label, aligned.mask,
+                row_len=aligned.row_len, num_shards=aligned.num_shards,
+            )
+        return aligned, 0
+    per_host = gathered[:, 1] + (agreed_raw - gathered[:, 0] + 1) // 2 + 1
+    agreed_codec = encoded_bucket(int(per_host.max()))
+    if agreed_codec >= agreed_raw:
+        return aligned, 0  # incompressible agreement: raw is smaller
+    # the codec rides the uint8 wire; all hosts agreed eligibility, so the
+    # narrow dtype is consistent fleet-wide (the uint16 harmonization is
+    # exactly what the eligibility gather replaces)
+    if aligned.units.dtype != _np.uint8:
+        aligned = RaggedUnitBatch(
+            _np.asarray(aligned.units, _np.uint8), aligned.offsets,
+            aligned.numeric, aligned.label, aligned.mask,
+            row_len=aligned.row_len, num_shards=aligned.num_shards,
+        )
+    return aligned, agreed_codec
 
 
 class MultiHostSGDModel:
@@ -143,11 +218,34 @@ class MultiHostSGDModel:
     handler already holds, so per-row telemetry (real/pred series) stays a
     host-local concern and no host ever fetches another host's rows."""
 
-    def __init__(self, inner, mesh):
+    def __init__(self, inner, mesh, rebuilder=None):
         self.inner = inner
         self.mesh = mesh
         self.num_data = inner.num_data
         self._lead = jax.process_index() == 0
+        # elastic membership (--elastic on): how to rebuild the inner
+        # mesh-sharded model for a re-formed epoch's mesh — a closure over
+        # the conf, set by apps/common.build_model
+        self._rebuilder = rebuilder
+
+    def rebuild(self, mesh) -> "MultiHostSGDModel":
+        """Swap in a fresh inner model on a NEW epoch's mesh IN PLACE —
+        every holder of this wrapper (fetch pipelines, checkpoint
+        closures, the sentinel) keeps working across an elastic membership
+        change. Weights start at zeros; the caller restores them from the
+        lead's broadcast checkpoint (the PR 4 path) before the next tick."""
+        if self._rebuilder is None:
+            raise RuntimeError(
+                "MultiHostSGDModel.rebuild needs the rebuilder closure "
+                "(set by apps/common.build_model)"
+            )
+        self.inner = self._rebuilder(mesh)
+        # the rebuilder may substitute a mesh (a shrunken 1-device epoch
+        # gets a synthesized 1-device data mesh) — the inner's is the truth
+        self.mesh = self.inner.mesh
+        self.num_data = self.inner.num_data
+        self._lead = jax.process_index() == 0
+        return self
 
     @property
     def latest_weights(self):
@@ -162,13 +260,14 @@ class MultiHostSGDModel:
 
     # the ragged wire packs per shard on multi-host too (pack_for_wire);
     # the app-side pack opt-in keys off this (apps/common.py).
-    # --wireCodec is NOT applied here by design: the compressed bucket is
-    # data-dependent per host, and the global buffer assembly below needs
-    # uniform per-segment bytes on EVERY process — agreeing a compressed
-    # bucket would add a collective to the lockstep tick (the PR 1/5 law
-    # says don't), so multi-host ships the raw packed wire and the app
-    # driver REJECTS --wireCodec dict on multi-host runs (apps/common.py).
+    # --wireCodec dict (r16, ROADMAP item 3 REMAINING): the cross-host
+    # compressed bucket rides the SAME pack-time alignment allgather the
+    # raw bucket already pays (_ragged_local_aligned_codec) — zero added
+    # collectives, asserted by the counted elastic acceptance test; set by
+    # apps/common.build_model, k=1 flat wire only (the coalesced group
+    # wire still rejects the codec on multi-host).
     accepts_packed = True
+    wire_codec = ""
 
     def step(self, local_batch):
         """Dispatch only — returns the StepOutput with predictions still
@@ -205,7 +304,11 @@ class MultiHostSGDModel:
         """The multi-host form of the one-buffer ragged wire: align this
         host's rows to its LOCAL shard segments (agreed bucket — uniform
         per-segment bytes on every host), pack them, and assemble the
-        global per-shard buffer from every process's contribution."""
+        global per-shard buffer from every process's contribution. With
+        ``wire_codec`` set, the compressed bucket is agreed on the SAME
+        alignment allgather and every host packs identical codec segment
+        shapes (or every host ships raw — the fallback decision is part of
+        the agreement, never per-host)."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from ..features.batch import PackedBatch, pack_ragged_sharded
@@ -215,8 +318,18 @@ class MultiHostSGDModel:
                 "pack_for_wire is the ragged wire's pack; padded batches "
                 "assemble as plain arrays"
             )
-        aligned = _ragged_local_aligned(local_batch, self.mesh)
-        pb = pack_ragged_sharded(aligned, num_shards_out=self.num_data)
+        if self.wire_codec:
+            aligned, codec_bucket = _ragged_local_aligned_codec(
+                local_batch, self.mesh, codec=self.wire_codec
+            )
+            pb = pack_ragged_sharded(
+                aligned, num_shards_out=self.num_data,
+                codec=self.wire_codec if codec_bucket else None,
+                codec_bucket=codec_bucket or None,
+            )
+        else:
+            aligned = _ragged_local_aligned(local_batch, self.mesh)
+            pb = pack_ragged_sharded(aligned, num_shards_out=self.num_data)
         sharding = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
         buf = jax.make_array_from_process_local_data(
             sharding, pb.buffer,

@@ -460,6 +460,10 @@ class StreamingContext:
         # rides the per-tick cadence allgather in lockstep runs and every
         # host can verify the group rolled back the same steps
         self.rollback_count_fn: "Callable[[], int] | None" = None
+        # elastic membership plane (--elastic on, streaming/membership.py):
+        # when set, peer loss re-forms the group instead of aborting it,
+        # and the membership columns ride the cadence allgather
+        self.membership = None
 
     def source_stream(
         self,
@@ -598,6 +602,34 @@ class StreamingContext:
         FetchPipeline)."""
         return self._stop.is_set()
 
+    def _putback(self, items: list) -> None:
+        """Return this tick's drained items to the queue FRONT in order —
+        an elastic membership transition re-forms the group between ticks,
+        and the rows drained for the interrupted tick must train on the
+        next one (no silent loss)."""
+        for item in reversed(items):
+            self._queue.putback(item)
+
+    def _elastic_recover(self, local: list, why: str) -> bool:
+        """Peer-loss recovery hook: with an elastic membership plane
+        installed, a wedged/failed cadence collective becomes a rescue
+        (shrink + re-form + continue) instead of an abort. Returns True
+        when the loop should continue on the re-formed group."""
+        if self.membership is None:
+            return False
+        self._putback(local)
+        _metrics.get_registry().counter("lockstep.elastic_rescues").inc()
+        log.critical(
+            "lockstep cadence collective failed (%s); elastic membership "
+            "is ON — attempting an out-of-band shrink instead of aborting",
+            why,
+        )
+        try:
+            return self.membership.rescue(why)
+        except Exception:
+            log.critical("elastic rescue failed", exc_info=True)
+            return False
+
     def _run_batch_aligned(self, statuses: list[Status], batch_time: float) -> None:
         """Lockstep-mode batch: host-local failures must never change this
         host's COLLECTIVE program sequence (the other hosts' psums would
@@ -714,6 +746,9 @@ class StreamingContext:
         import jax
         import numpy as np
 
+        from . import faults as _faults
+        from . import membership as _membership
+
         watch_s = float(
             os.environ.get(LOCKSTEP_TIMEOUT_ENV, "")
             or LOCKSTEP_TIMEOUT_DEFAULT_S
@@ -724,6 +759,7 @@ class StreamingContext:
         limit = getattr(self._stream, "row_bucket", 0)
         next_tick = time.monotonic() + self.batch_interval
         aborting = False
+        tick_no = 0
         while not self._stop.is_set():
             if self.batch_interval > 0 and not aborting:
                 delay = next_tick - time.monotonic()
@@ -738,6 +774,11 @@ class StreamingContext:
                     and not self._stop.is_set()
                 ):
                     self._stop.wait(0.002)
+            tick_no += 1
+            # --chaos peer.kill/peer.pause: membership churn injectable
+            # from the CLI like every other fault (streaming/faults.py) —
+            # a hard exit or a long stall at a deterministic tick
+            _faults.lockstep_chaos(tick_no, self.batch_interval)
             local = self._drain(limit)
             rows = sum(getattr(s, "rows", 1) for s in local)
             more = (not self._source.exhausted) or self._queue.rows_queued > 0
@@ -751,10 +792,16 @@ class StreamingContext:
                 if self.rollback_count_fn is not None
                 else 0
             )
+            mem_cols = (
+                self.membership.pre_tick()
+                if self.membership is not None
+                else np.zeros((_membership.WIDTH,), np.float64)
+            )
             try:
-                # the sideband rides the SAME allgather: flags widen from 4
-                # ints to 4 + sideband.WIDTH floats (int flags are exact in
-                # float64) — never a second collective
+                # the sideband AND the membership columns ride the SAME
+                # allgather: flags widen from 4 ints to 4 + membership.WIDTH
+                # + sideband.WIDTH floats (int flags are exact in float64)
+                # — never a second collective
                 flags = _watched_allgather(
                     np.concatenate([
                         np.array(
@@ -762,11 +809,20 @@ class StreamingContext:
                              more and not aborting, aborting, rollbacks],
                             dtype=np.float64,
                         ),
+                        mem_cols,
                         tele.vector(rollbacks=rollbacks),
                     ]),
                     watch_s,
                 )
             except Exception:
+                if self._elastic_recover(
+                    local, "cadence allgather transport error"
+                ):
+                    tele = _sideband.LockstepTelemetry(
+                        jax.process_index(), jax.process_count()
+                    )
+                    next_tick = time.monotonic() + self.batch_interval
+                    continue
                 log.critical(
                     "lockstep cadence allgather FAILED — a peer likely "
                     "died mid-run; aborting this host loudly (progress up "
@@ -781,6 +837,14 @@ class StreamingContext:
                 break
             tele.tick_done()  # waiting-in-collective ends here
             if flags is None:
+                if self._elastic_recover(
+                    local, f"no allgather progress in {watch_s:.0f}s"
+                ):
+                    tele = _sideband.LockstepTelemetry(
+                        jax.process_index(), jax.process_count()
+                    )
+                    next_tick = time.monotonic() + self.batch_interval
+                    continue
                 log.critical(
                     "lockstep peer watchdog: the cadence allgather made no "
                     "progress in %.0fs — a peer is gone (hard kill or "
@@ -801,10 +865,42 @@ class StreamingContext:
             # single-process gathers come back without the process axis
             flags = np.atleast_2d(np.asarray(flags))
             fi = flags[:, :4].astype(np.int64)  # the lockstep decisions
-            if flags.shape[1] > 4:
+            mem_end = 4 + _membership.WIDTH
+            if flags.shape[1] > mem_end:
                 # per-host sideband matrix: straggler attribution + the
                 # hosts[] view (pure host-side bookkeeping)
-                tele.ingest(flags[:, 4:].astype(np.float64))
+                tele.ingest(flags[:, mem_end:].astype(np.float64))
+            if self.membership is not None:
+                action = self.membership.ingest(
+                    flags[:, 4:mem_end].astype(np.int64)
+                )
+                if action == "reform":
+                    # a committed view change: this tick's rows go back to
+                    # the queue, the group re-forms (members of the new
+                    # view; a clean commit is loss-free — the lead
+                    # checkpoints inside the transition), and the loop
+                    # resumes on the new epoch
+                    self._putback(local)
+                    self.membership.execute_reform()
+                    tele = _sideband.LockstepTelemetry(
+                        jax.process_index(), jax.process_count()
+                    )
+                    next_tick = time.monotonic() + self.batch_interval
+                    continue
+                if action == "parked":
+                    # evicted: leave the group, then poll for readmission
+                    self._putback(local)
+                    if self.membership.park():
+                        tele = _sideband.LockstepTelemetry(
+                            jax.process_index(), jax.process_count()
+                        )
+                        next_tick = time.monotonic() + self.batch_interval
+                        continue
+                    self.request_abort(
+                        "elastic: evicted from the lockstep group and not "
+                        "readmitted within the park window"
+                    )
+                    break
             if fi[:, 2].any():
                 # this host (or a peer) aborted: everyone has now agreed on
                 # it in the same tick, so everyone can stop dispatching

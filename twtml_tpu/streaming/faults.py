@@ -68,10 +68,19 @@ log = get_logger("streaming.faults")
 
 TRANSPORT_TARGETS = ("fetch", "step", "web")
 SOURCE_TARGETS = ("source.garbage", "source.burst", "source.nan")
-CHAOS_TARGETS = TRANSPORT_TARGETS + SOURCE_TARGETS
+# membership churn (r16, ISSUE 13): peer death/stall injectable from the
+# CLI like every other fault — previously only reachable via
+# tests/distributed_worker.py's peer_kill mode
+PEER_TARGETS = ("peer.kill", "peer.pause")
+CHAOS_TARGETS = TRANSPORT_TARGETS + SOURCE_TARGETS + PEER_TARGETS
 
 # extra re-emits per source.burst firing when the rule gives no rows=N
 BURST_DEFAULT_EXTRA = 4
+# default peer.pause stall length (lockstep ticks' worth of wall time)
+PAUSE_DEFAULT_TICKS = 4
+# exit code of a peer.kill hard death (test-assertable, distinct from the
+# jax coordination-service SIGABRT and from clean failures)
+PEER_KILL_EXIT_CODE = 77
 
 
 class InjectedFault(ConnectionError):
@@ -99,9 +108,12 @@ class _ChaosRule:
         return rng.random() < self.param
 
     def __repr__(self) -> str:  # shows up in the install log line
+        if self.kind == "kill":
+            return f"{self.target} (at lockstep tick {int(self.value)})"
         act = (
             "error" if self.kind == "error"
             else "inject" if self.kind == "inject"
+            else f"pause={int(self.value)} ticks" if self.kind == "pause"
             else f"delay={self.value:g}s"
         )
         trig = {"every": "every %d", "from": "from call %d on",
@@ -153,6 +165,38 @@ class ChaosInjector:
                     f"TARGET in {CHAOS_TARGETS}"
                 )
             mode, param = _parse_trigger(trigger) if trigger else ("every", 1)
+            if target in PEER_TARGETS:
+                # membership churn: peer.kill[:tick=N] hard-exits this host
+                # at lockstep tick N (default 1); peer.pause[:ticks=K]
+                # stalls it for ~K ticks' wall time at the trigger's ticks
+                if target == "peer.kill":
+                    if action and not action.startswith("tick="):
+                        raise ValueError(
+                            f"bad chaos action {action!r} in {clause!r}: "
+                            "peer.kill takes tick=N"
+                        )
+                    value = int(action.partition("=")[2]) if action else 1
+                    if value < 1:
+                        raise ValueError(f"non-positive tick in {clause!r}")
+                    rules.append(
+                        _ChaosRule(target, "kill", value, "every", value)
+                    )
+                else:
+                    if action and not action.startswith("ticks="):
+                        raise ValueError(
+                            f"bad chaos action {action!r} in {clause!r}: "
+                            "peer.pause takes ticks=K"
+                        )
+                    value = (
+                        int(action.partition("=")[2]) if action
+                        else PAUSE_DEFAULT_TICKS
+                    )
+                    if value < 1:
+                        raise ValueError(f"non-positive ticks in {clause!r}")
+                    rules.append(
+                        _ChaosRule(target, "pause", value, mode, param)
+                    )
+                continue
             if target in SOURCE_TARGETS:
                 # the injection IS the action; only source.burst takes a
                 # magnitude (rows=N extra re-emits per firing)
@@ -262,6 +306,52 @@ class ChaosInjector:
     def calls(self, target: str) -> int:
         return self._calls.get(target, 0)
 
+    def peer_chaos(self, tick: int, interval: float) -> None:
+        """``peer.kill``/``peer.pause`` injection, driven by the lockstep
+        scheduler once per tick (the TICK NUMBER is the call index —
+        deterministic on every host, so a rule fires at the same point of
+        each host's own loop). A kill is a HARD exit (``os._exit`` with
+        ``PEER_KILL_EXIT_CODE``): no abort broadcast, no goodbye — exactly
+        the failure the peer watchdog + elastic rescue path exist for. A
+        pause sleeps ~K ticks' worth of wall time (``K x max(interval,
+        0.5s)``), long enough to trip the peer watchdog when K x interval
+        exceeds ``TWTML_LOCKSTEP_TIMEOUT_S``."""
+        from ..telemetry import blackbox as _blackbox
+        from ..telemetry import metrics as _metrics
+
+        for r in self._rules.get("peer.kill", ()):
+            if tick == int(r.value):
+                log.critical(
+                    "chaos: peer.kill firing at lockstep tick %d — hard "
+                    "exit %d (no abort broadcast)", tick,
+                    PEER_KILL_EXIT_CODE,
+                )
+                _metrics.get_registry().counter("chaos.injected").inc()
+                _blackbox.record("chaos", target="peer.kill", tick=tick)
+                import os as _os
+                import sys as _sys
+
+                _sys.stdout.flush()
+                _sys.stderr.flush()
+                _os._exit(PEER_KILL_EXIT_CODE)
+        rules = self._rules.get("peer.pause", ())
+        if not rules:
+            return
+        with self._lock:
+            fired = [r for r in rules if r.fires(tick, self._rng)]
+        for r in fired:
+            dur = int(r.value) * max(float(interval), 0.5)
+            _metrics.get_registry().counter("chaos.injected").inc()
+            _metrics.get_registry().counter("chaos.peer.pauses").inc()
+            _blackbox.record(
+                "chaos", target="peer.pause", tick=tick, secs=round(dur, 2),
+            )
+            log.warning(
+                "chaos: peer.pause stalling this host %.1fs (~%d ticks) "
+                "at lockstep tick %d", dur, int(r.value), tick,
+            )
+            time.sleep(dur)
+
 
 # process-wide injector: injection points are scattered across layers
 # (apps/common fetch+dispatch, telemetry/web_client) and all belong to the
@@ -295,6 +385,14 @@ def perturb(target: str) -> None:
     installed (one global read on the hot path)."""
     if _CHAOS is not None:
         _CHAOS.perturb(target)
+
+
+def lockstep_chaos(tick: int, interval: float) -> None:
+    """``peer.*`` injection point, called by the lockstep scheduler at the
+    top of every tick (streaming/context._lockstep_loop). No-op unless a
+    chaos spec with peer rules is installed."""
+    if _CHAOS is not None:
+        _CHAOS.peer_chaos(tick, interval)
 
 
 # -- source/parse injection points (r7 — the ingest-guard failure domain) ----

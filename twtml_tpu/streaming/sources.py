@@ -617,7 +617,16 @@ class ShardedSource(Source):
     every host opens the same replay/synthetic source and keeps 1/N of the
     stream, so the union of all hosts' shards is exactly the single-host
     stream and host i's k-th batch interleaves with the others into the
-    same global row set a single-host run would batch."""
+    same global row set a single-host run would batch.
+
+    **Elastic rebalance (r16)**: the shard key is a RESIDUE SET, not a
+    single index — ``count`` stays the LAUNCH process count forever, and a
+    departed host's residue classes are adopted by survivors
+    (``adopt_residues``), so coverage going forward is exact without
+    re-keying anyone's position. ``produce`` reads the set per item, so an
+    adoption takes effect mid-stream from each adopter's current position
+    (items of the departed residues between the death and the takeover are
+    the counted loss window — streaming/membership.py)."""
 
     name = "shard"
 
@@ -628,10 +637,26 @@ class ShardedSource(Source):
         self.inner = inner
         self.index = index
         self.count = count
+        self.residues = {index}
+
+    def adopt_residues(self, residues) -> None:
+        """Take over the given residue classes (a departed host's shard),
+        effective from this source's current stream position."""
+        self.residues |= {int(r) % self.count for r in residues}
+        log.warning(
+            "intake shard rebalanced: now serving residues %s of %d",
+            sorted(self.residues), self.count,
+        )
+
+    def release_residues(self, residues) -> None:
+        """Hand residue classes back (a rejoined live host resumes its own
+        slice); this host's original residue is never released."""
+        self.residues -= {int(r) % self.count for r in residues}
+        self.residues.add(self.index)
 
     def produce(self) -> Iterator[Status]:
         for i, status in enumerate(self.inner.produce()):
-            if i % self.count == self.index:
+            if i % self.count in self.residues:
                 yield status
 
 
@@ -661,10 +686,25 @@ class IdShardedSource(Source):
         self.inner = inner
         self.index = index
         self.count = count
+        self.residues = {index}
+
+    def adopt_residues(self, residues) -> None:
+        """Elastic rebalance (r16): serve a departed host's id-residue
+        classes too, from this connection, going forward — exact coverage
+        for a live stream (ids are position-free, unlike replay indexes)."""
+        self.residues |= {int(r) % self.count for r in residues}
+        log.warning(
+            "live intake shard rebalanced: now serving id residues %s of %d",
+            sorted(self.residues), self.count,
+        )
+
+    def release_residues(self, residues) -> None:
+        self.residues -= {int(r) % self.count for r in residues}
+        self.residues.add(self.index)
 
     def produce(self) -> Iterator[Status]:
         for status in self.inner.produce():
-            if status.id % self.count == self.index:
+            if status.id % self.count in self.residues:
                 yield status
 
     def _backoff(self, exc: Exception, restarts: int) -> float:

@@ -85,6 +85,13 @@ class Hosts:
     straggler: int = -1
     stage: str = ""
     skewMs: float = 0.0
+    # elastic membership (r16): current epoch (-1 = not elastic), live
+    # member count, and cumulative departed/rejoined hosts — decode
+    # defaults keep legacy frames valid
+    epoch: int = -1
+    liveHosts: int = 0
+    departed: int = 0
+    rejoined: int = 0
 
     json_class = "Hosts"
 

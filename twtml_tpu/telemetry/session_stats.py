@@ -229,9 +229,19 @@ class SessionStats:
         view = _sideband.last_hosts()
         if view is not None and self._web_breaker.allow():
             try:
+                # elastic membership summary rides the same Hosts frame
+                # (registry gauges the membership plane maintains; zero
+                # when the run is not elastic)
+                msnap = _metrics.get_registry().snapshot()
+                gauges = msnap["gauges"]
+                counters = msnap["counters"]
                 self.web.hosts(
                     view["hosts"], view["straggler"], view["stage"],
                     view["skew_ms"],
+                    epoch=int(gauges.get("elastic.epoch", -1)),
+                    live_hosts=int(gauges.get("elastic.live_hosts", 0)),
+                    departed=int(counters.get("elastic.hosts_departed", 0)),
+                    rejoined=int(counters.get("elastic.hosts_rejoined", 0)),
                 )
                 self._web_breaker.record_success()
             except Exception:

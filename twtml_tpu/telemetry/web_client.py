@@ -85,11 +85,16 @@ class WebClient:
                            histograms=dict(histograms or {})))
 
     def hosts(self, hosts: list, straggler: int = -1, stage: str = "",
-              skew_ms: float = 0.0) -> None:
+              skew_ms: float = 0.0, epoch: int = -1, live_hosts: int = 0,
+              departed: int = 0, rejoined: int = 0) -> None:
         """Push the per-host lockstep sideband view for the dashboard's
-        Hosts tile row (additive message; telemetry/sideband.py)."""
+        Hosts tile row (additive message; telemetry/sideband.py), plus the
+        elastic membership summary (epoch, live host count, cumulative
+        departed/rejoined — streaming/membership.py gauges)."""
         self._post(Hosts(hosts=list(hosts), straggler=int(straggler),
-                         stage=str(stage), skewMs=float(skew_ms)))
+                         stage=str(stage), skewMs=float(skew_ms),
+                         epoch=int(epoch), liveHosts=int(live_hosts),
+                         departed=int(departed), rejoined=int(rejoined)))
 
     def tenants(self, tenants: list, gating: int = -1, active: int = 0) -> None:
         """Push the per-tenant model-plane view for the dashboard's Tenants

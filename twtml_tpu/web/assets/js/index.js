@@ -83,6 +83,16 @@
     straggler.classList.toggle("degraded", gating);
     document.getElementById("tickSkew").textContent =
       String(json.skewMs || 0);
+    // elastic membership (streaming/membership.py): epoch + live host
+    // count, cumulative churn; "—" when the run is not elastic
+    const elastic = Number(json.epoch) >= 0;
+    document.getElementById("elasticEpoch").textContent = elastic
+      ? json.epoch + " · " + (json.liveHosts || 0) + " host" +
+        ((json.liveHosts || 0) === 1 ? "" : "s")
+      : "—";
+    document.getElementById("elasticChurn").textContent = elastic
+      ? (json.departed || 0) + " / " + (json.rejoined || 0)
+      : "—";
     const panel = document.getElementById("hostsPanel");
     panel.replaceChildren();
     for (const h of json.hosts || []) {
