@@ -393,6 +393,41 @@ def test_write_baseline_roundtrip(tmp_path, capsys):
 # registry + acceptance
 
 
+def test_tw008_fires_on_fresh_pack_alloc(tmp_path):
+    """r17 arena law: a pack-path function allocating its wire buffer
+    fresh — np.empty, or np.concatenate without an out= destination —
+    fires; the blessed arena-lease pattern right next to it stays
+    quiet."""
+    report = run(tmp_path, {"twtml_tpu/features/batch.py": (
+        "import numpy as np\n"
+        "from .arena import lease_wire\n"
+        "def pack_batch(batch):\n"
+        "    buf = np.empty((1024,), np.uint8)\n"        # fires
+        "    return np.concatenate([buf, buf])\n"        # fires (no out=)
+        "def pack_ragged_sharded(rb):\n"
+        "    lease = lease_wire(2048)\n"
+        "    out = lease.buf\n"
+        "    np.concatenate([out[:1024], out[1024:]], out=out)\n"  # quiet
+        "    return out\n"
+        "def featurize_helper():\n"
+        "    return np.zeros((64,), np.uint8)\n"          # out of scope
+    )})
+    lines = [f.line for f in report.findings if f.rule == "TW008"]
+    assert lines == [4, 5]
+
+
+def test_tw008_scoped_to_pack_hot_path(tmp_path):
+    """The same allocations OUTSIDE the scoped modules (or outside
+    pack-path functions) are not findings — the law covers the wire
+    buffer the transport client retains, not every numpy call."""
+    report = run(tmp_path, {"twtml_tpu/streaming/sources.py": (
+        "import numpy as np\n"
+        "def pack_batch(batch):\n"
+        "    return np.empty((1024,), np.uint8)\n"
+    )})
+    assert "TW008" not in rules_fired(report)
+
+
 def test_rule_registry_is_stable():
     rules = all_rules()
     ids = [r.id for r in rules]

@@ -46,6 +46,7 @@ class Rule:
 
 
 def all_rules() -> "list[Rule]":
+    from .arena import TW008WireArena
     from .device import TW004Scatter
     from .docs import TW007FlagDocs
     from .host import TW005SilentSwallow, TW006WallClock
@@ -59,6 +60,7 @@ def all_rules() -> "list[Rule]":
         TW005SilentSwallow(),
         TW006WallClock(),
         TW007FlagDocs(),
+        TW008WireArena(),
     ]
 
 

@@ -293,6 +293,91 @@ def _check_codec_parity(native, np) -> "list[str]":
     return errors
 
 
+def _check_assemble_parity(native, np) -> "list[str]":
+    """Fused wire assembler (native/wireassemble.cpp) vs the numpy pack
+    pipeline (features/batch.py, the ground truth), byte-for-byte across
+    flat / per-shard / coalesced-group layouts × codec on/off × narrow
+    and int32 offsets × uint16-widened and incompressible fallbacks —
+    under ASan/UBSan (segment-stride memcpys over ragged offsets: the
+    OOB class the sanitizers exist for)."""
+    from twtml_tpu.features import assemble
+    from twtml_tpu.features.batch import (
+        RaggedUnitBatch, align_ragged_shards, pack_batch,
+        pack_ragged_group, pack_ragged_sharded, ragged_wire_arrays,
+    )
+
+    if not native.assemble_available():
+        return ["wire_assemble unavailable in the instrumented library"]
+    errors: list[str] = []
+    rng = random.Random(17)
+
+    def make(b, seed, wide=False, incompressible=False, row_len=96):
+        r = random.Random(seed)
+        rows = []
+        for i in range(b - 3):
+            n = r.randrange(1, row_len)
+            if incompressible:
+                rows.append([r.randrange(0, 128) for _ in range(n)])
+            else:
+                text = b"the streaming fox https://t.co/ab again "
+                rows.append([text[j % len(text)] for j in range(n)])
+        if wide and rows:
+            rows[0] = rows[0] + [0x3042]
+        units = np.array(
+            [u for row in rows for u in row], np.uint16
+        ).reshape(-1)
+        offsets = np.zeros(len(rows) + 1, np.int64)
+        np.cumsum([len(row) for row in rows], out=offsets[1:])
+        flat, offs = ragged_wire_arrays(
+            units, offsets, len(rows), b, narrow=not wide
+        )
+        numeric = np.arange(b * 4, dtype=np.float32).reshape(b, 4) + seed
+        label = np.arange(b, dtype=np.float32) * 0.5
+        mask = np.zeros(b, np.float32)
+        mask[: len(rows)] = 1.0
+        return RaggedUnitBatch(
+            flat, offs, numeric, label, mask, row_len=row_len
+        )
+
+    def both(tag, fn):
+        with assemble.forced("off"):
+            ref = fn()
+        with assemble.forced("on"):
+            got = fn()
+        if got.layout != ref.layout:
+            errors.append(f"assemble {tag}: layout diverged")
+        elif not np.array_equal(
+            np.asarray(got.buffer), np.asarray(ref.buffer)
+        ):
+            errors.append(f"assemble {tag}: buffer bytes diverged")
+
+    for codec in (None, "dict"):
+        for wide in (False, True):
+            for inc in (False, True):
+                rb = make(32, rng.randrange(1 << 20), wide, inc)
+                both(f"flat c={codec} w={wide} i={inc}",
+                     lambda rb=rb, c=codec: pack_batch(rb, codec=c))
+                for s in (1, 2, 4):
+                    al = align_ragged_shards(rb, s)
+                    both(f"shard{s} c={codec} w={wide} i={inc}",
+                         lambda al=al, c=codec: pack_ragged_sharded(
+                             al, codec=c))
+                al2 = align_ragged_shards(rb, 2)
+                parts = [
+                    RaggedUnitBatch(
+                        al2.units.copy(), al2.offsets.copy(),
+                        al2.numeric + j, al2.label + j, al2.mask.copy(),
+                        row_len=al2.row_len, num_shards=al2.num_shards,
+                    )
+                    for j in range(3)
+                ]
+                both(f"group c={codec} w={wide} i={inc}",
+                     lambda p=parts, c=codec: pack_ragged_group(p, codec=c))
+    rb = make(32, 5)
+    both("flat raw-offs", lambda: pack_batch(rb, narrow_offsets=False))
+    return errors
+
+
 def main() -> int:
     os.environ.setdefault("TWTML_NATIVE_SANITIZE", "asan,ubsan")
     modes = {m.strip()
@@ -320,6 +405,7 @@ def main() -> int:
     errors += _check_pad_units(native, np)
     errors += _check_block_wire_parity(native, np)
     errors += _check_codec_parity(native, np)
+    errors += _check_assemble_parity(native, np)
     for e in errors:
         print(f"native_sanity: FAIL {e}", file=sys.stderr)
     print(

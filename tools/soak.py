@@ -9,8 +9,29 @@ weights and streams the identical corpus, so the final-batch mse must be
 BIT-IDENTICAL on every pass — any drift, leak-induced slowdown, or
 transport wedge fails loudly.
 
+r17 additions (ISSUE 14):
+
+- the RSS **slope** (least-squares MB/min over per-pass samples of the
+  live VmRSS) joins the JSON line, and ``--maxRssSlopeMbPerMin X`` turns
+  the soak into a CI/ops GATE: exit 1 when the slope breaches X — RSS
+  flatness becomes assertable instead of eyeballed.
+- ``--arena <on|off>`` toggles the pooled wire-buffer arena
+  (features/arena.py): the soak retires each pass's pack leases at the
+  pass's completion fetch (every dispatch has provably executed by then),
+  so arena-on reuses the same destination buffers pass over pass while
+  arena-off is the pre-r17 fresh-allocation control arm. The two slopes,
+  recorded side by side, are the arena's RSS evidence (BENCHMARKS.md
+  "One-pass wire assembly (r17)").
+
 Usage: python tools/soak.py [--minutes M] [--tweets N]
-Prints one JSON line at the end.
+       [--arena on|off] [--wireAssemble auto|on|off]
+       [--maxRssSlopeMbPerMin X] [--configs both|dense|hash2e18]
+Prints one JSON line at the end (exit 1 on a slope breach).
+
+``--configs dense`` keeps only the dense ragged arm — the wire-heavy
+config whose uploaded bytes drive the axon retention, and the one a
+cpu-only control window can actually cycle (the 2^18 Gram step is
+minutes per pass on the one-core host; on the chip it is ~21 ms).
 """
 
 from __future__ import annotations
@@ -25,26 +46,61 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
+def _slope_mb_per_min(samples: "list[tuple[float, float]]") -> float:
+    """Least-squares RSS slope over (seconds, MB) samples — robust to the
+    sawtooth a GC'd process shows, unlike endpoint deltas."""
+    n = len(samples)
+    if n < 2:
+        return 0.0
+    xs = [t / 60.0 for t, _ in samples]
+    ys = [m for _, m in samples]
+    mx = sum(xs) / n
+    my = sum(ys) / n
+    denom = sum((x - mx) ** 2 for x in xs)
+    if denom == 0:
+        return 0.0
+    return sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / denom
+
+
 def main(argv=None) -> None:
     args = list(sys.argv[1:] if argv is None else argv)
     minutes, n_tweets = 15.0, 65536
+    arena_on, assemble_mode = True, "auto"
+    max_slope = None
+    configs = "both"
     i = 0
     while i < len(args):
         if args[i] == "--minutes":
             minutes = float(args[i + 1]); i += 2
         elif args[i] == "--tweets":
             n_tweets = int(args[i + 1]); i += 2
+        elif args[i] == "--arena":
+            arena_on = args[i + 1] == "on"; i += 2
+        elif args[i] == "--wireAssemble":
+            assemble_mode = args[i + 1]; i += 2
+        elif args[i] == "--maxRssSlopeMbPerMin":
+            max_slope = float(args[i + 1]); i += 2
+        elif args[i] == "--configs":
+            configs = args[i + 1]; i += 2
         else:
             raise SystemExit(f"unknown flag {args[i]!r}")
 
     import jax
 
+    from twtml_tpu.features import arena as _arena, assemble as _assemble
     from twtml_tpu.features.featurizer import Featurizer
     from twtml_tpu.models import StreamingLinearRegressionWithSGD
     from twtml_tpu.streaming.sources import SyntheticSource
     from twtml_tpu.utils.benchloop import _run_once
+    from twtml_tpu.utils.rss import rss_mb
+
+    _assemble.configure(assemble_mode)
+    _arena.set_enabled(arena_on)
 
     statuses = list(SyntheticSource(total=n_tweets, seed=3).produce())
+    # per-pass pack leases, retired at the pass's completion fetch (every
+    # dispatch has executed by then — the arena's retire-on-delivery rule)
+    pass_leases: list = []
 
     def arm(f_text, batch, l2):
         feat = Featurizer(num_text_features=f_text, now_ms=1785320000000)
@@ -53,9 +109,13 @@ def main(argv=None) -> None:
         ]
 
         def fz(c):
-            return feat.featurize_batch_ragged(
+            pb = feat.featurize_batch_ragged(
                 c, row_bucket=batch, pre_filtered=True, pack=True
             )
+            lease = getattr(pb, "_lease", None)
+            if lease is not None:
+                pass_leases.append(lease)
+            return pb
 
         model = StreamingLinearRegressionWithSGD(
             num_text_features=f_text, l2_reg=l2
@@ -63,11 +123,14 @@ def main(argv=None) -> None:
         float(model.step(fz(chunks[0])).mse)  # warm
         return model, fz, chunks
 
-    arms = {
+    arms = {}
+    if configs in ("both", "dense"):
         # the r4 operating points (BENCHMARKS.md "r4 operating point")
-        "dense_ragged_b16384": arm(1000, 16384, 0.0),
-        "hash2e18_ragged_b3072": arm(2**18, 3072, 0.1),
-    }
+        arms["dense_ragged_b16384"] = arm(1000, 16384, 0.0)
+    if configs in ("both", "hash2e18"):
+        arms["hash2e18_ragged_b3072"] = arm(2**18, 3072, 0.1)
+    if not arms:
+        raise SystemExit(f"unknown --configs {configs!r}")
     from twtml_tpu.utils.rss import RssWatchdog
 
     reference_mse: dict[str, float] = {}
@@ -77,12 +140,20 @@ def main(argv=None) -> None:
     # warn with the axon-client diagnosis + checkpoint-restart workaround
     # as growth crosses each threshold — the soak records whether it fired
     watchdog = RssWatchdog(sample_every=1)
-    t_end = time.perf_counter() + minutes * 60
+    t_start = time.perf_counter()
+    t_end = t_start + minutes * 60
+    rss_samples: "list[tuple[float, float]]" = [(0.0, rss_mb())]
     while time.perf_counter() < t_end:
         for name, (model, fz, chunks) in arms.items():
             model.reset()
+            pass_leases.clear()
             _, last = _run_once(model, fz, chunks, prefetch=True)
             mse = float(last.mse)
+            # completion fetch done ⇒ every dispatch consumed its wire:
+            # the pass's leases retire to the pool (arena-on) or no-op
+            for lease in pass_leases:
+                lease.retire()
+            pass_leases.clear()
             if name not in reference_mse:
                 reference_mse[name] = mse
             elif mse != reference_mse[name]:
@@ -92,7 +163,14 @@ def main(argv=None) -> None:
                 )
             passes[name] += 1
             watchdog.tick()
+            rss_samples.append(
+                (time.perf_counter() - t_start, rss_mb())
+            )
     rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    slope = round(_slope_mb_per_min(rss_samples), 3)
+    breach = max_slope is not None and slope > max_slope
+    from twtml_tpu.features.arena import get_arena
+
     print(json.dumps({
         "soak_minutes": minutes,
         "tweets_per_pass": n_tweets,
@@ -101,9 +179,18 @@ def main(argv=None) -> None:
         "final_mse": reference_mse,
         "bit_identical": True,
         "rss_growth_mb": round((rss1 - rss0) / 1024, 1),
+        "rss_slope_mb_per_min": slope,
+        "rss_slope_gate_mb_per_min": max_slope,
+        "rss_slope_breach": breach,
+        "rss_samples": len(rss_samples),
+        "arena": "on" if arena_on else "off",
+        "wire_assemble": assemble_mode,
+        "arena_stats": get_arena().stats(),
         "rss_watchdog_warnings": watchdog.warn_count,
         "backend": jax.default_backend(),
     }))
+    if breach:
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":

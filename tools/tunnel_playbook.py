@@ -1,0 +1,197 @@
+"""First-tunnel-window playbook (ROADMAP item 5): probe the backend, and
+on a LIVE window run the prioritized paired-bench backlog end to end —
+so the next lucky window costs ONE command instead of a session.
+
+NINE consecutive sessions found no reachable TPU tunnel while the
+measurement backlog grew to span five shipped features. This tool makes
+the window cheap to exploit:
+
+1. **Probe** — a subprocess imports jax WITHOUT the CPU pin (the test
+   conftest and CI set ``JAX_PLATFORMS=cpu``; the probe strips it) under
+   a hard timeout, and reports the backend it actually got. A hung
+   tunnel handshake is a dead window, not a hung session.
+2. **Backlog** — on a live accelerator the prioritized bench list runs
+   sequentially, each under its own timeout. Every tool here is built on
+   the house harness (tools/pairedbench.py: interleaved arms, paired
+   per-round ratios), so each verdict is health-phase-safe by
+   construction; the playbook adds the cross-tool discipline — priority
+   order (the standing ``auto``-default decisions first), per-tool wall
+   clocks sized to straddle the tunnel's ~10-minute health phases, and
+   one BENCHMARKS-ready JSONL record per tool.
+3. **Retune notes** — after the run it prints the flip instructions for
+   each standing ``auto`` default (``--wireCodec``, ``--wirePack``)
+   keyed to the thresholds BENCHMARKS.md records, so the session that
+   hits the window can also land the config change.
+
+On a cpu-only probe it emits ``{"live": false, ...}`` and exits 0 — the
+attempt itself is the BENCHMARKS record (the per-PR "probed, cpu-only"
+line).
+
+Usage: python tools/tunnel_playbook.py [--probeTimeout S] [--budget S]
+       [--only NAME] [--out PATH] [--force]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# the prioritized backlog: (name, argv tail, per-tool timeout seconds,
+# why it is in the queue — the BENCHMARKS section its number lands in).
+# Budgets are sized to straddle the tunnel's ~10-minute health phases
+# (CLAUDE.md): a verdict measured inside one phase window is not a
+# verdict (the r2/r3 interleaving law).
+BACKLOG = (
+    ("wirecodec", ["tools/bench_wirecodec.py", "--regime", "both",
+                   "--budget", "600"], 1800,
+     "the standing --wireCodec auto decision: bandwidth improves with "
+     "transfer size, so the modeled arm cannot capture a smaller "
+     "transfer landing on a worse bandwidth point (BENCHMARKS "
+     "'Compressed wire')"),
+    ("wireassemble", ["tools/bench_wireassemble.py", "--regime", "both",
+                      "--budget", "300"], 1200,
+     "r17 fused pack on the real tunnel: host-chain dilution under live "
+     "upload (BENCHMARKS 'One-pass wire assembly')"),
+    ("superwire", ["tools/bench_superwire.py", "--budget", "600"], 1800,
+     "the standing --wirePack auto decision (BENCHMARKS 'Lean wire v2' "
+     "flip instructions)"),
+    ("fleet", ["tools/bench_fleet.py", "--modelRttMs", "0",
+               "--budget", "300"], 1200,
+     "fleet QPS with the REAL tunnel instead of the 70 ms modeled RTT "
+     "(ROADMAP item 2 REMAINING)"),
+    ("serving", ["tools/bench_serving.py", "--modelRttMs", "0",
+                 "--budget", "300"], 1200,
+     "serving-plane QPS, real tunnel (ROADMAP item 5 backlog)"),
+    ("tenants", ["tools/bench_tenants.py", "--budget", "300"], 1200,
+     "the tenant >=3x verdict in the regime that motivated it "
+     "(per-batch telemetry through a real RTT)"),
+    ("blockparse", ["tools/bench_blockparse.py"], 900,
+     "block-wire ingest rates on the tunnel (PR 6 REMAINING)"),
+    ("soak", ["tools/soak.py", "--minutes", "20",
+              "--maxRssSlopeMbPerMin", "10"], 1800,
+     "the axon RSS retention under the arena (r17): slope gate proves "
+     "the pooled transfer buffers bound it (ROADMAP item 5)"),
+)
+
+RETUNE_NOTES = """\
+Retune instructions (apply in config.py, cite the JSONL record):
+- wirecodec: if paired_upload_bound group_codec_vs_raw >= 1.10 across
+  the live window, flip effective_wire_codec()'s auto default to 'dict'
+  (and effective_wire_pack resolves group automatically).
+- superwire: if the group arm wins paired >= 1.05 live, flip
+  effective_wire_pack()'s auto default to 'group'.
+- wireassemble: auto already means on-when-available (host-only work);
+  record the live host-chain dilution next to the CPU number.
+- soak: slope <= gate with --arena on proves the r17 mitigation on the
+  real transport; record both slopes in BENCHMARKS 'Endurance soaks'.
+"""
+
+
+def probe(timeout_s: float) -> dict:
+    """Backend probe in a subprocess with the CPU pin stripped — a hung
+    tunnel handshake times out there, not here."""
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    code = (
+        "import json, jax; "
+        "print(json.dumps({'backend': jax.default_backend(), "
+        "'devices': len(jax.devices())}))"
+    )
+    t0 = time.perf_counter()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], env=env, cwd=REPO,
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        got = json.loads(out.stdout.strip().splitlines()[-1]) if (
+            out.returncode == 0 and out.stdout.strip()
+        ) else {"backend": "error", "devices": 0,
+                "stderr": out.stderr[-500:]}
+    except subprocess.TimeoutExpired:
+        got = {"backend": "timeout", "devices": 0}
+    except Exception as exc:  # probe infrastructure failure, not a verdict
+        got = {"backend": "error", "devices": 0, "error": str(exc)}
+    got["probe_s"] = round(time.perf_counter() - t0, 2)
+    got["live"] = got.get("backend") not in ("cpu", "timeout", "error")
+    return got
+
+
+def run_backlog(only: "str | None", budget_scale: float, out_path: str,
+                sink) -> list:
+    records = []
+    for name, argv, timeout_s, why in BACKLOG:
+        if only and name != only:
+            continue
+        scaled = [
+            str(int(float(a) * budget_scale))
+            if argv[i - 1] in ("--budget", "--minutes") else a
+            for i, a in enumerate(argv)
+        ]
+        t0 = time.time()
+        rec = {"tool": name, "argv": scaled, "t_start": round(t0, 1),
+               "why": why}
+        try:
+            out = subprocess.run(
+                [sys.executable, *scaled], cwd=REPO,
+                capture_output=True, text=True,
+                timeout=timeout_s * budget_scale,
+            )
+            lines = [ln for ln in out.stdout.strip().splitlines() if ln]
+            try:
+                rec["result"] = json.loads(lines[-1]) if lines else None
+            except json.JSONDecodeError:
+                rec["result"] = None
+                rec["stdout_tail"] = "\n".join(lines[-3:])
+            rec["exit"] = out.returncode
+            if out.returncode != 0:
+                rec["stderr_tail"] = out.stderr[-800:]
+        except subprocess.TimeoutExpired:
+            rec["exit"] = -1
+            rec["timeout_s"] = timeout_s * budget_scale
+        rec["seconds"] = round(time.time() - t0, 1)
+        records.append(rec)
+        line = json.dumps(rec)
+        print(line, file=sink, flush=True)
+        with open(out_path, "a", encoding="utf-8") as fh:
+            fh.write(line + "\n")
+    return records
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+
+    def opt(name, default, cast):
+        if name in args:
+            return cast(args[args.index(name) + 1])
+        return default
+
+    probe_timeout = opt("--probeTimeout", 120.0, float)
+    budget_scale = opt("--budget", 1.0, float)
+    only = opt("--only", None, str)
+    out_path = opt(
+        "--out", os.path.join(REPO, "tunnel_playbook_out.jsonl"), str
+    )
+    force = "--force" in args  # run the backlog even on a cpu probe
+
+    got = probe(probe_timeout)
+    header = {"playbook": "tunnel", "probe": got,
+              "t": round(time.time(), 1)}
+    print(json.dumps(header), flush=True)
+    with open(out_path, "a", encoding="utf-8") as fh:
+        fh.write(json.dumps(header) + "\n")
+    if not got["live"] and not force:
+        # the attempt IS the record: append the probe line to the
+        # BENCHMARKS backlog section by hand (or let the PR do it)
+        return 0
+    run_backlog(only, budget_scale, out_path, sys.stdout)
+    print(RETUNE_NOTES, file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

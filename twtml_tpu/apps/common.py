@@ -463,6 +463,13 @@ def build_model(conf, model_cls=StreamingLinearRegressionWithSGD):
     app-level multi-host wiring keeps its single-model plane for now."""
     import jax as _jax
 
+    # --wireAssemble: the fused one-pass native pack (r17) is a process-
+    # wide seam — every packer (plain / mesh / multi-host / tenant) rides
+    # it through features/batch.py, so one configure covers them all
+    from ..features import assemble as _assemble
+
+    _assemble.configure(getattr(conf, "wireAssemble", "auto") or "auto")
+
     tenants = int(getattr(conf, "tenants", 1) or 1)
     # TWTML_FORCE_TENANT_PLANE=1 routes even --tenants 1 through the
     # stacked program — the app-level M=1 differential-parity hook (the
@@ -1489,7 +1496,7 @@ class SuperBatcher:
     def _emit_group(self) -> None:
         from ..models.base import StepOutput
 
-        future, group, outs = self._inflight.pop(0)
+        future, group, outs, lease = self._inflight.pop(0)
         try:
             host = self._watchdog.await_result(
                 future,
@@ -1501,10 +1508,17 @@ class SuperBatcher:
             # the group trained but its outputs are gone with the wedged
             # tunnel: refund the cap slots so every dispatched batch is
             # either delivered to the handler or refunded (flush refunds
-            # the remaining in-flight groups the same way)
+            # the remaining in-flight groups the same way); the wire
+            # buffer's arena lease is discarded, never reused — the
+            # dispatch may still execute on the wedged backend
+            if lease is not None:
+                lease.discard()
             for _ in group:
                 self.refund_dispatch()
             raise
+        if lease is not None:
+            # fetch delivered ⇒ the dispatch consumed its wire bytes
+            lease.retire()
         last = len(group) - 1
         # _buf is provably empty at every emit site, so the pipeline being
         # drained is the whole weights-current condition
@@ -1675,6 +1689,7 @@ class SuperBatcher:
                 # same watchdog as the pooled paths (the fetch rides the
                 # pool so the deadline can fire; awaited immediately, so
                 # the partial path stays effectively synchronous)
+                lease = getattr(wire, "_lease", None)
                 try:
                     out = self._watchdog.await_result(
                         self._pool.submit(self._timed_fetch_one, out_dev),
@@ -1683,8 +1698,12 @@ class SuperBatcher:
                         ),
                     )
                 except FetchAbort:
+                    if lease is not None:
+                        lease.discard()  # wedged dispatch: no reuse
                     self.refund_dispatch()
                     raise
+                if lease is not None:
+                    lease.retire()
                 self.handle(out, batch, t, at_boundary=True)
             return
         # backpressure + timeliness, as in FetchPipeline (the already-done
@@ -1709,7 +1728,7 @@ class SuperBatcher:
                         depth=len(self._inflight))
         self._inflight.append(
             (self._pool.submit(self._timed_fetch_many, outs, len(group)),
-             group, outs)
+             group, outs, getattr(wire, "_lease", None))
         )
         self._depth_gauge.set(len(self._inflight))
         self._dispatched += len(group)
@@ -1733,7 +1752,9 @@ class SuperBatcher:
                 # are gone with the wedged tunnel — cap accounting follows
                 # deliveries; buffered batches never dispatched, nothing to
                 # refund there)
-                for _future, group, _outs in self._inflight:
+                for _future, group, _outs, lease in self._inflight:
+                    if lease is not None:
+                        lease.discard()  # wedged dispatches: no reuse
                     for _ in group:
                         self.refund_dispatch()
                 log.warning(
@@ -1871,10 +1892,21 @@ class FetchPipeline:
         return host
 
     def _emit_one(self) -> None:
-        future, out, batch, t = self._pending.pop(0)
-        host = self._watchdog.await_result(
-            future, lambda: self._pool.submit(self._timed_fetch, out)
-        )
+        future, out, batch, t, lease = self._pending.pop(0)
+        try:
+            host = self._watchdog.await_result(
+                future, lambda: self._pool.submit(self._timed_fetch, out)
+            )
+        except FetchAbort:
+            # the dispatch may still execute on the wedged backend: never
+            # donate its wire buffer back for reuse (features/arena.py)
+            if lease is not None:
+                lease.discard()
+            raise
+        if lease is not None:
+            # fetch delivered ⇒ the dispatch consumed its wire bytes: the
+            # arena lease retires to the pool
+            lease.retire()
         self.handle(host, batch, t, at_boundary=not self._pending)
 
     def _drain(self) -> None:
@@ -1943,7 +1975,8 @@ class FetchPipeline:
         if tr.enabled:
             tr.complete("dispatch", t0, dt, depth=len(self._pending))
         self._pending.append(
-            (self._pool.submit(self._timed_fetch, out), out, batch, t)
+            (self._pool.submit(self._timed_fetch, out), out, batch, t,
+             getattr(wire, "_lease", None))
         )
         self._depth_gauge.set(len(self._pending))
         self._dispatched += 1
@@ -2017,6 +2050,9 @@ class FetchPipeline:
                     "dropping %d undelivered batch output(s) after the "
                     "fetch abort", len(self._pending),
                 )
+                for _f, _o, _b, _t, lease in self._pending:
+                    if lease is not None:
+                        lease.discard()  # wedged dispatches: no reuse
                 self._pending.clear()
         finally:
             # shutdown in a finally: an exception re-raised from
@@ -2438,6 +2474,7 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
                 )
             else:
                 wire = batch
+            lease = getattr(wire, "_lease", None)
             td = _time.perf_counter()
             _faults.perturb("step")  # --chaos dispatch injection
             out = model.step(wire)
@@ -2457,6 +2494,8 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
             _sideband.record_stage("fetch", dt)
             if tr.enabled:
                 tr.complete("fetch", t0, dt, depth=1)
+            if lease is not None:
+                lease.retire()  # synchronous fetch: dispatch consumed it
             handle(out, batch, t, at_boundary=True)
 
         stream.foreach_batch(skip_empty(per_batch))

@@ -168,6 +168,14 @@ class ConfArguments:
                 "wireCodec must be 'auto', 'off' or 'dict', got "
                 f"{self.wireCodec!r}"
             )
+        # fused one-pass wire assembly on a pooled buffer arena (r17):
+        # the native emitter builds the final packed wire in one C sweep
+        self.wireAssemble: str = conf.get("wireAssemble", "auto")
+        if self.wireAssemble not in ("auto", "on", "off"):
+            raise ValueError(
+                "wireAssemble must be 'auto', 'on' or 'off', got "
+                f"{self.wireAssemble!r}"
+            )
         self.recycleAfterMb: int = int(conf.get("recycleAfterMb", "0"))
         # elastic lockstep membership (r16): host loss shrinks the fleet
         # instead of aborting it; recovered hosts rejoin at epoch
@@ -548,6 +556,19 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                                                default recorded in BENCHMARKS.md "Compressed
                                                wire" (currently off pending a tunnel-regime
                                                verdict). Default: {self.wireCodec}
+  --wireAssemble <auto|on|off>                 Fused one-pass wire assembly (r17): 'on' builds
+                                               every packed wire (flat / per-shard / coalesced
+                                               group) in ONE native C sweep — units digram-
+                                               encoded during the copy, uint16-delta offsets,
+                                               sideband laid behind — into a pooled buffer
+                                               arena (features/arena.py; leases retire when the
+                                               batch's stats fetch delivers). Byte-identical
+                                               wires and bitwise-equal trajectories vs the
+                                               numpy pack pipeline (tests/test_wireassemble.py).
+                                               auto = on whenever the native assembler is
+                                               loadable (host-only work, no transport-regime
+                                               gate); off = the numpy ground truth.
+                                               Default: {self.wireAssemble}
 """
 
     def parse(self, args: list[str]) -> "ConfArguments":
@@ -654,6 +675,10 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
         elif flag == "--wireCodec":
             self.wireCodec = take()
             if self.wireCodec not in ("auto", "off", "dict"):
+                self.printUsage(1)
+        elif flag == "--wireAssemble":
+            self.wireAssemble = take()
+            if self.wireAssemble not in ("auto", "on", "off"):
                 self.printUsage(1)
         elif flag == "--recycleAfterMb":
             self.recycleAfterMb = int(take())

@@ -146,6 +146,15 @@ class PackedBatch:
     def __init__(self, buffer, layout: tuple):
         self.buffer = buffer
         self.layout = layout  # (cls_name, ((shape, dtype_str), ...))
+        # arena lease backing the buffer (features/arena.py), when the
+        # pack leased its destination: the dispatch pipelines retire it
+        # once the corresponding fetch delivers (apps/common.py). Not
+        # pytree state — a re-built PackedBatch simply carries no lease.
+        self._lease = None
+
+    def _with_lease(self, lease) -> "PackedBatch":
+        self._lease = lease
+        return self
 
     @property
     def num_valid(self) -> int:
@@ -603,6 +612,23 @@ def ragged_wire_arrays(
     return flat, offs
 
 
+def _finish_pack(chunks, axis: int, layout: tuple) -> PackedBatch:
+    """The one place the numpy packers materialize their final wire
+    buffer: ``np.concatenate`` into an ARENA-LEASED destination
+    (features/arena.py — fresh per-tick wire buffers are the TW008
+    finding class: one-core CPU churn plus fuel for the measured
+    axon-client RSS retention). The lease rides the PackedBatch to the
+    dispatch pipelines, which retire it on fetch delivery."""
+    from .arena import lease_wire
+
+    lease = lease_wire(sum(c.nbytes for c in chunks))
+    shape = list(chunks[0].shape)
+    shape[axis] = sum(c.shape[axis] for c in chunks)
+    out = lease.buf.reshape(shape)
+    np.concatenate(chunks, axis=axis, out=out)
+    return PackedBatch(out.reshape(-1), layout)._with_lease(lease)
+
+
 def pack_ragged_sharded(
     rb: "RaggedUnitBatch", num_shards_out: int = 0,
     narrow_offsets: "bool | None" = None,
@@ -647,6 +673,15 @@ def pack_ragged_sharded(
         offsets_narrow(rb.row_len) if narrow_offsets is None
         else narrow_offsets
     )
+    # fused native fast path (r17): one C sweep emits the identical final
+    # buffer into an arena lease; None falls through to the ground truth
+    from .assemble import try_assemble_sharded
+
+    fast = try_assemble_sharded(
+        rb, s, bl, n_sb, narrow, codec, codec_bucket, num_shards_out
+    )
+    if fast is not None:
+        return fast
     offs_wire = (
         (_offsets_to_deltas(rb.offsets, s), (bl,))
         if narrow
@@ -672,10 +707,9 @@ def pack_ragged_sharded(
         (rb.row_len, num_shards_out or s, "u16delta" if narrow else "i32")
         + (() if codes is None else (("dict", n_sb),)),
     )
-    buffer = np.concatenate(
-        [f.view(np.uint8).reshape(s, -1) for f in fields], axis=1
-    ).reshape(-1)
-    return PackedBatch(buffer, layout)
+    return _finish_pack(
+        [f.view(np.uint8).reshape(s, -1) for f in fields], 1, layout
+    )
 
 
 def _unpack_ragged_shards(buffer, layout: tuple) -> "RaggedUnitBatch":
@@ -800,6 +834,15 @@ def pack_ragged_group(
         offsets_narrow(first.row_len) if narrow_offsets is None
         else narrow_offsets
     )
+    # fused native fast path (r17): one C sweep over the K batches emits
+    # the identical shard-major buffer; None falls through to the truth
+    from .assemble import try_assemble_group
+
+    fast = try_assemble_group(
+        batches, s, bl, n_sb, narrow, codec, num_shards_out
+    )
+    if fast is not None:
+        return fast
     specs = (
         ((lambda rb: rb.units), (n_sb,)),
         (
@@ -836,10 +879,9 @@ def pack_ragged_group(
             "u16delta" if narrow else "i32",
         ) + (() if codes is None else (("dict", n_sb),)),
     )
-    buffer = np.concatenate(
-        [f.view(np.uint8).reshape(s, k, -1) for f in fields], axis=2
-    ).reshape(-1)
-    return PackedBatch(buffer, layout)
+    return _finish_pack(
+        [f.view(np.uint8).reshape(s, k, -1) for f in fields], 2, layout
+    )
 
 
 def _decode_offsets_stacked(arr, s_here: int):
@@ -935,6 +977,13 @@ def pack_batch(
             offsets_narrow(batch.row_len) if narrow_offsets is None
             else narrow_offsets
         )
+        # fused native fast path (r17): the k=1, s=1 degenerate of the
+        # same C entry; None falls through to the ground truth
+        from .assemble import try_assemble_flat
+
+        fast = try_assemble_flat(batch, narrow, codec)
+        if fast is not None:
+            return fast
         offs = (
             _offsets_to_deltas(batch.offsets, batch.num_shards)
             if narrow
@@ -958,8 +1007,9 @@ def pack_batch(
         type(batch).__name__,
         tuple((a.shape, a.dtype.str) for a in fields),
     ) + ((extra,) if extra is not None else ())
-    buffer = np.concatenate([a.view(np.uint8).reshape(-1) for a in fields])
-    return PackedBatch(buffer, layout)
+    return _finish_pack(
+        [a.view(np.uint8).reshape(-1) for a in fields], 0, layout
+    )
 
 
 def unpack_batch(buffer, layout: tuple):

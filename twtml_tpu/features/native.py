@@ -25,6 +25,7 @@ _SRCS = [
     os.path.join(_REPO_ROOT, "native", "fasthash.cpp"),
     os.path.join(_REPO_ROOT, "native", "tweetjson.cpp"),
     os.path.join(_REPO_ROOT, "native", "wirecodec.cpp"),
+    os.path.join(_REPO_ROOT, "native", "wireassemble.cpp"),
 ]
 # TWTML_NATIVE_LIB: alternate build/load path for the shared library. The
 # sanitizer harness (tools/native_sanity.py) builds an ASan/UBSan-
@@ -91,6 +92,11 @@ _wire_missing = False
 # library missing ``digram_encode`` only flags this, and the codec falls
 # back to the byte-identical numpy encoder (features/wirecodec.encode_np)
 _codec_missing = False
+# and for the fused wire assembler (r17): a stale library missing
+# ``wire_assemble`` only flags this — one warning + the
+# ``native.assemble_degraded`` counter — and every pack falls back to the
+# byte-identical numpy pipeline (features/batch.py, the ground truth)
+_assemble_missing = False
 
 
 def _build() -> bool:
@@ -239,6 +245,7 @@ def _load(path: str, strict: bool = True) -> ctypes.CDLL:
     ]
     _bind_wire(lib, strict)
     _bind_codec(lib, strict)
+    _bind_assemble(lib, strict)
     return lib
 
 
@@ -315,6 +322,124 @@ def _bind_codec(lib: ctypes.CDLL, strict: bool) -> None:
         ctypes.c_int64,  # cap
     ]
     _codec_missing = False
+
+
+def _bind_assemble(lib: ctypes.CDLL, strict: bool) -> None:
+    """Bind the fused one-pass wire assembler (native/wireassemble.cpp).
+    Same degrade contract as ``_bind_wire``/``_bind_codec``: strict loads
+    raise (get_lib rebuilds), degraded loads flag ``_assemble_missing``
+    ONCE — warning + ``native.assemble_degraded`` counter — and every
+    pack keeps running on the byte-identical numpy pipeline."""
+    global _assemble_missing
+    try:
+        fn = lib.wire_assemble
+    except AttributeError:
+        if strict:
+            raise
+        _assemble_missing = True
+        log.warning(
+            "native library is stale: wire_assemble missing — packs use "
+            "the numpy pipeline (delete native/libfasthash.so to force a "
+            "rebuild of the fused one-pass assembler)"
+        )
+        from ..telemetry import metrics as _metrics
+
+        _metrics.get_registry().counter("native.assemble_degraded").inc()
+        return
+    fn.restype = ctypes.c_int64
+    fn.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p),  # units ptrs [k]
+        ctypes.POINTER(ctypes.c_void_p),  # offsets ptrs [k]
+        ctypes.POINTER(ctypes.c_void_p),  # numeric ptrs [k]
+        ctypes.POINTER(ctypes.c_void_p),  # label ptrs [k]
+        ctypes.POINTER(ctypes.c_void_p),  # mask ptrs [k]
+        ctypes.c_int64,  # k
+        ctypes.c_int64,  # s
+        ctypes.c_int64,  # n_sb
+        ctypes.c_int64,  # bl
+        ctypes.c_int64,  # unit_size
+        ctypes.c_int64,  # narrow_offsets
+        ctypes.POINTER(ctypes.c_uint8),  # lut (None = codec off)
+        ctypes.c_int64,  # forced codec bucket
+        ctypes.POINTER(ctypes.c_uint8),  # scratch
+        ctypes.POINTER(ctypes.c_int64),  # enc_lens
+        ctypes.POINTER(ctypes.c_uint8),  # out
+        ctypes.c_int64,  # cap
+        ctypes.POINTER(ctypes.c_int64),  # out enc_bucket
+    ]
+    _assemble_missing = False
+
+
+def assemble_available() -> bool:
+    """Whether the fused wire assembler is loadable (library up and the
+    symbol present — see _bind_assemble's degrade seam)."""
+    return get_lib() is not None and not _assemble_missing
+
+
+def _ptr_array(arrays: "list[np.ndarray]"):
+    return (ctypes.c_void_p * len(arrays))(
+        *[a.ctypes.data for a in arrays]
+    )
+
+
+def wire_assemble(
+    units: "list[np.ndarray]",
+    offsets: "list[np.ndarray]",
+    numeric: "list[np.ndarray]",
+    label: "list[np.ndarray]",
+    mask: "list[np.ndarray]",
+    s: int,
+    n_sb: int,
+    bl: int,
+    narrow: bool,
+    lut: "np.ndarray | None",
+    forced_bucket: int,
+    scratch: "np.ndarray | None",
+    enc_lens: "np.ndarray | None",
+    out: np.ndarray,
+) -> "tuple[int, int] | None":
+    """One C pass from K batches' field arrays to the final packed wire
+    buffer (native/wireassemble.cpp). Returns (written bytes,
+    enc_bucket — 0 = raw units wire), or None when the library is
+    unavailable, predates the assembler, or reports an input the caller
+    must route through the numpy ground truth (delta overflow, forced
+    codec bucket under-coverage — the numpy path raises the canonical
+    errors). The caller (features/assemble.py) owns eligibility gating,
+    layout construction, and the arena leases for ``scratch``/``out``."""
+    lib = get_lib()
+    if lib is None or _assemble_missing:
+        return None
+    k = len(units)
+    u8 = ctypes.POINTER(ctypes.c_uint8)
+    i64 = ctypes.POINTER(ctypes.c_int64)
+    enc_bucket = ctypes.c_int64(0)
+    total = lib.wire_assemble(
+        _ptr_array(units),
+        _ptr_array(offsets),
+        _ptr_array(numeric),
+        _ptr_array(label),
+        _ptr_array(mask),
+        k,
+        s,
+        n_sb,
+        bl,
+        int(units[0].dtype.itemsize),
+        1 if narrow else 0,
+        lut.ctypes.data_as(u8) if lut is not None else None,
+        int(forced_bucket),
+        scratch.ctypes.data_as(u8) if scratch is not None else None,
+        enc_lens.ctypes.data_as(i64) if enc_lens is not None else None,
+        out.ctypes.data_as(u8),
+        int(out.shape[0]),
+        ctypes.byref(enc_bucket),
+    )
+    if total < 0:
+        # -2 delta overflow / -3 forced-bucket under-coverage: the numpy
+        # path raises the canonical ValueError; -1 capacity means the
+        # caller mis-sized the lease — same route, the ground truth can
+        # never hit it
+        return None
+    return int(total), int(enc_bucket.value)
 
 
 def digram_encode(buf: np.ndarray, lut: np.ndarray) -> "np.ndarray | None":

@@ -335,7 +335,9 @@ class MultiHostSGDModel:
             sharding, pb.buffer,
             (pb.buffer.shape[0] * jax.process_count(),),
         )
-        return PackedBatch(buf, pb.layout)
+        # the local buffer's arena lease rides to the dispatch pipeline
+        # (retired once the step's fetch delivers — apps/common.py)
+        return PackedBatch(buf, pb.layout)._with_lease(pb._lease)
 
     def pack_group_for_wire(self, batches):
         """Multi-host form of the COALESCED superbatch wire: align each of
@@ -358,7 +360,7 @@ class MultiHostSGDModel:
             sharding, pb.buffer,
             (pb.buffer.shape[0] * jax.process_count(),),
         )
-        return PackedBatch(buf, pb.layout)
+        return PackedBatch(buf, pb.layout)._with_lease(pb._lease)
 
     def step_many(self, stacked):
         """K-batch group over the multi-host mesh: the app pre-aligns and

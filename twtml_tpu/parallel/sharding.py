@@ -603,12 +603,14 @@ class ParallelSGDModel:
         pb = pack_ragged_sharded(
             self.prepare(batch), codec=self.wire_codec or None
         )
+        # the host buffer's arena lease rides to the dispatch pipeline,
+        # which retires it once the step's fetch delivers (apps/common.py)
         return PackedBatch(
             jax.device_put(
                 pb.buffer, NamedSharding(self.mesh, P(self.data_axis))
             ),
             pb.layout,
-        )
+        )._with_lease(pb._lease)
 
     def pack_group_for_wire(self, batches) -> PackedBatch:
         """The mesh form of the COALESCED superbatch wire (Lean wire v2):
@@ -626,7 +628,7 @@ class ParallelSGDModel:
                 pb.buffer, NamedSharding(self.mesh, P(self.data_axis))
             ),
             pb.layout,
-        )
+        )._with_lease(pb._lease)
 
     def _packed_rows(self, pb: PackedBatch, group: bool = False) -> int:
         """Global row count recorded in a RaggedShardSegments (or, for the

@@ -401,13 +401,15 @@ class TenantStackModel:
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
                 pb = pack_ragged_group(parts, codec=codec)
+                # the host buffer's arena lease rides to the dispatch
+                # pipeline (retired on fetch delivery — apps/common.py)
                 return PackedBatch(
                     jax.device_put(
                         pb.buffer,
                         NamedSharding(self.mesh, P(self._data_axis)),
                     ),
                     pb.layout,
-                )
+                )._with_lease(pb._lease)
             return pack_ragged_group(parts, codec=codec)
         return stack_batches(parts)
 

@@ -50,6 +50,11 @@
     // the latest packed batch; 1.00 = codec off or shipping raw
     document.getElementById("wireRatio").textContent =
       (Number(gauges["wire.codec_ratio"] || 1)).toFixed(2);
+    // pooled wire arena (r17): outstanding leases and cumulative pool
+    // recycles (wire.arena_* — features/arena.py)
+    document.getElementById("arenaPool").textContent =
+      String(gauges["wire.arena_in_use"] || 0) + " · " +
+      String(counters["wire.arena_recycled"] || 0);
     document.getElementById("rssMb").textContent =
       String(gauges["host.rss_mb"] || 0);
     document.getElementById("fetchDepth").textContent =
