@@ -49,6 +49,18 @@ def test_wire_codec_measures():
     assert rec["paired_group_codec_upload55"] > 0
 
 
+def test_featurize_measures():
+    """The one-pass featurize config (ISSUE 15) must run both regimes
+    and report the paired ratios plus the sub-stage split — plumbing
+    only, tiny sizes."""
+    rec = bench_suite.run_config("featurize", 2048, 512)
+    assert rec["paired_fused_vs_r17"] > 0
+    assert rec["paired_truth_vs_r17"] > 0
+    assert rec["tweets_per_sec_fused"] > 0
+    assert rec["paired_block_chain"] > 0
+    assert rec["block_chain_tweets_per_sec"] > 0
+
+
 def test_twitter_live_measures_local_protocol_without_creds(clean_properties):
     """Without creds, config #2 measures the REAL TwitterSource → train
     path against the in-process v1.1 server (VERDICT r2 #6), tagged so it

@@ -548,10 +548,11 @@ int64_t parse_tweet_block(const char*, int64_t, int64_t, int64_t, int64_t,
         assert lib.parse_tweet_block is not None  # old symbols still bound
     finally:
         native._wire_missing = saved
-        # rebind the real library's wire entry (module-global flag shared)
-        real = native.get_lib()
-        if real is not None:
-            native._bind_wire(real, strict=False)
+        # re-evaluate EVERY degrade flag against the real library: the
+        # degraded _load above also flagged the r15/r17/r18 symbols this
+        # stale lib lacks, and restoring only _wire_missing left those
+        # fast paths silently off for the rest of the suite
+        native.rebind_flags()
 
 
 # ---------------------------------------------------------------------------

@@ -570,9 +570,9 @@ int64_t digram_encode(const uint8_t*, int64_t, const uint8_t*, uint8_t*,
         assert lib.digram_encode is not None  # old symbols still bound
     finally:
         native._assemble_missing = saved
-        real = native.get_lib()
-        if real is not None:
-            native._bind_assemble(real, strict=False)
+        # every degrade flag, not just ours: the degraded _load also
+        # flagged the r18 featurize symbol this stale lib lacks
+        native.rebind_flags()
 
 
 # ---------------------------------------------------------------------------

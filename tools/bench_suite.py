@@ -52,6 +52,7 @@ CONFIGS = [
     "multi_tenant_m8",
     "serving_qps",
     "wire_codec",
+    "featurize",
 ]
 
 
@@ -511,6 +512,33 @@ def run_config(name: str, n_tweets: int, batch_size: int = 0) -> dict:
                 modeled["paired_upload_bound"]["55"]["group_codec_vs_raw"]
             ),
             "final_metric": rec["control"]["final_mse"],
+        })
+    elif name == "featurize":
+        # one-pass host featurize (ISSUE 15): the featurize stage split
+        # into sub-stages and paired r17/truth/fused on the object path
+        # plus the block host chain — tools/bench_featurize.py is the
+        # full harness; this is its compact per-config form
+        from tools.bench_featurize import measure as featurize_measure
+
+        small = n_tweets < 16384  # plumbing-test sizes stay fast
+        obj = featurize_measure(
+            regime="object", n_tweets=min(n_tweets, 65536),
+            batch=batch_size if explicit_batch else 8192,
+            budget_s=3.0 if small else 25.0,
+        )["object"]
+        blk = featurize_measure(
+            regime="block", n_tweets=min(n_tweets, 65536),
+            batch=batch_size if explicit_batch else 8192,
+            budget_s=3.0 if small else 25.0,
+        )["block"]
+        out.update({
+            "paired_fused_vs_r17": obj["paired_fused_vs_r17"],
+            "paired_truth_vs_r17": obj["paired_truth_vs_r17"],
+            "tweets_per_sec_fused": obj["tweets_per_sec_fused"],
+            "paired_block_chain": blk["paired_chain_fused_vs_truth"],
+            "block_chain_tweets_per_sec": blk[
+                "chain_tweets_per_sec_fused"
+            ],
         })
     elif name in ("sharded_dp4", "sharded_dp4_logistic", "sharded_2e18_2d"):
         from twtml_tpu.parallel import ParallelSGDModel, make_mesh

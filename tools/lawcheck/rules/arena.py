@@ -40,11 +40,15 @@ class TW008WireArena(Rule):
         "(lease_wire / _finish_pack), retiring on fetch delivery"
     )
     # the pack/dispatch hot path: every module that builds a wire buffer
-    # the transport client will see (featurize-stage intermediates are a
-    # different ladder rung and stay out of scope for r17)
+    # the transport client will see — r18 extended the law one rung up
+    # the ladder to the fused featurize emitters (features/
+    # featurize_native.py: the one-pass fill's destination arrays are
+    # wire-adjacent and per-tick, so a fresh allocation there is the
+    # same regression class)
     SCOPE = (
         "twtml_tpu/features/batch.py",
         "twtml_tpu/features/assemble.py",
+        "twtml_tpu/features/featurize_native.py",
         "twtml_tpu/apps/common.py",
         "twtml_tpu/parallel/sharding.py",
         "twtml_tpu/parallel/distributed.py",
@@ -62,7 +66,8 @@ class TW008WireArena(Rule):
             ) and (
                 node.name.startswith("pack_")
                 or node.name.startswith("try_assemble")
-                or node.name == "_group_wire"
+                or node.name.startswith("try_fill")
+                or node.name in ("_group_wire", "_lease_views")
             ):
                 yield node
 

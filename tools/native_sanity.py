@@ -378,6 +378,87 @@ def _check_assemble_parity(native, np) -> "list[str]":
     return errors
 
 
+def _check_featurize_parity(native, np) -> "list[str]":
+    """One-pass fused featurize (native/featurize.cpp) vs the
+    Python/numpy ground truth (features/featurizer.py), bit-for-bit on
+    both ingest paths — under ASan/UBSan (the narrowing units copy and
+    the column-order indexed reads are exactly the OOB class the
+    sanitizers exist for)."""
+    from twtml_tpu.features import featurize_native as ffz
+    from twtml_tpu.features.blocks import ParsedBlock
+    from twtml_tpu.features.featurizer import Featurizer, Status
+
+    if not native.featurize_available():
+        return ["featurize_wire unavailable in the instrumented library"]
+    errors: list[str] = []
+    rng = random.Random(99)
+    statuses = []
+    for i, text in enumerate(_texts_corpus()):
+        statuses.append(Status(
+            text="RT", retweet_count=1,
+            retweeted_status=Status(
+                text=text,
+                retweet_count=rng.choice((99, 100, 500, 1000, 1001)),
+                followers_count=rng.randrange(0, 10**7),
+                favourites_count=rng.randrange(0, 10**6),
+                friends_count=rng.randrange(0, 10**5),
+                created_at_ms=rng.randrange(0, 1785313333333),
+            ),
+        ))
+        if i % 11 == 0:
+            statuses.append(Status(text="plain, filtered out"))
+    feat = Featurizer(now_ms=1785313333333)
+
+    def both(tag, fn):
+        with ffz.forced("off"):
+            ref = fn()
+        with ffz.forced("on"):
+            got = fn()
+        for f in ("units", "offsets", "numeric", "label", "mask"):
+            a, b = getattr(ref, f), getattr(got, f)
+            if a.dtype != b.dtype or not np.array_equal(a, b):
+                errors.append(f"featurize {tag}: {f} diverged")
+                return
+        if ref.row_len != got.row_len:
+            errors.append(f"featurize {tag}: row_len diverged")
+
+    both("object mixed", lambda: feat.featurize_batch_ragged(
+        statuses, row_bucket=0))
+    ascii_only = [
+        s for s in statuses
+        if s.retweeted_status is not None
+        and s.retweeted_status.text.isascii()
+    ]
+    both("object ascii", lambda: feat.featurize_batch_ragged(
+        ascii_only, row_bucket=64, pre_filtered=True))
+    both("object empty", lambda: feat.featurize_batch_ragged(
+        [], row_bucket=8))
+    parsed = native.parse_tweet_block_wire(_block_corpus(), 0, 10**9)
+    if parsed is None:
+        errors.append("featurize: block wire parser unavailable")
+        return errors
+    block = ParsedBlock(*parsed[:4])
+    both("block mixed", lambda: feat.featurize_parsed_block(
+        block, row_bucket=0, ragged=True))
+    keep_ascii = [i for i in range(block.rows) if block.ascii[i]]
+    if keep_ascii:
+        stop = 0
+        while stop < block.rows and block.ascii[stop]:
+            stop += 1
+        from twtml_tpu.features.blocks import slice_block
+
+        ascii_blk = slice_block(block, 0, stop)
+        both("block ascii prefix", lambda: feat.featurize_parsed_block(
+            ascii_blk, row_bucket=32, ragged=True))
+        wide_blk = ParsedBlock(
+            ascii_blk.numeric, ascii_blk.units.astype(np.uint16),
+            ascii_blk.offsets, ascii_blk.ascii,
+        )
+        both("block u16 ascii", lambda: feat.featurize_parsed_block(
+            wide_blk, row_bucket=32, ragged=True))
+    return errors
+
+
 def main() -> int:
     os.environ.setdefault("TWTML_NATIVE_SANITIZE", "asan,ubsan")
     modes = {m.strip()
@@ -406,6 +487,7 @@ def main() -> int:
     errors += _check_block_wire_parity(native, np)
     errors += _check_codec_parity(native, np)
     errors += _check_assemble_parity(native, np)
+    errors += _check_featurize_parity(native, np)
     for e in errors:
         print(f"native_sanity: FAIL {e}", file=sys.stderr)
     print(

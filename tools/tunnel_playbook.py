@@ -73,6 +73,10 @@ BACKLOG = (
      "(per-batch telemetry through a real RTT)"),
     ("blockparse", ["tools/bench_blockparse.py"], 900,
      "block-wire ingest rates on the tunnel (PR 6 REMAINING)"),
+    ("featurize", ["tools/bench_featurize.py", "--budget", "120"], 900,
+     "r18 one-pass featurize: host-stage ratios are backend-free, but "
+     "the tunnel window shows the end-to-end dilution under live "
+     "upload (BENCHMARKS 'One-pass featurize')"),
     ("soak", ["tools/soak.py", "--minutes", "20",
               "--maxRssSlopeMbPerMin", "10"], 1800,
      "the axon RSS retention under the arena (r17): slope gate proves "

@@ -469,6 +469,12 @@ def build_model(conf, model_cls=StreamingLinearRegressionWithSGD):
     from ..features import assemble as _assemble
 
     _assemble.configure(getattr(conf, "wireAssemble", "auto") or "auto")
+    # --featurizeNative: the one-pass fused featurize (r18) is the same
+    # kind of process-wide seam — both ingest paths ride it through the
+    # featurizer, so one configure covers object and block streams
+    from ..features import featurize_native as _ffz
+
+    _ffz.configure(getattr(conf, "featurizeNative", "auto") or "auto")
 
     tenants = int(getattr(conf, "tenants", 1) or 1)
     # TWTML_FORCE_TENANT_PLANE=1 routes even --tenants 1 through the
@@ -1310,6 +1316,20 @@ class FetchWatchdog:
 _codec_fallback_warned = False
 
 
+def _dispatch_lease(wire, *batches):
+    """The arena lease(s) a dispatch must hold until its fetch delivers:
+    the packed wire's own lease plus the featurize-stage lease riding
+    each unpacked batch (the one-pass native featurizer, r18, leases its
+    output arrays from the same arena). Identity-deduplicating — an
+    unpacked dispatch sees the same object through both views."""
+    from ..features.arena import chain_leases
+
+    return chain_leases(
+        getattr(wire, "_lease", None),
+        *(getattr(b, "_lease", None) for b in batches),
+    )
+
+
 def _record_wire_codec(wire, requested: str) -> None:
     """Per-pack codec telemetry (r15 satellite): the compressed-units
     split from ``features/batch.wire_composition`` → the
@@ -1516,9 +1536,6 @@ class SuperBatcher:
             for _ in group:
                 self.refund_dispatch()
             raise
-        if lease is not None:
-            # fetch delivered ⇒ the dispatch consumed its wire bytes
-            lease.retire()
         last = len(group) - 1
         # _buf is provably empty at every emit site, so the pipeline being
         # drained is the whole weights-current condition
@@ -1533,6 +1550,11 @@ class SuperBatcher:
                 batch, t,
                 at_boundary=(k == last and boundary_ok),
             )
+        if lease is not None:
+            # fetch delivered ⇒ the dispatch consumed its wire bytes;
+            # retired AFTER the handlers (the lease may chain the group
+            # batches' featurize-stage arrays — see FetchPipeline)
+            lease.retire()
 
     def _timed_fetch_many(self, outs, group_len: int):
         """Timed pooled group fetch — see FetchPipeline._timed_fetch."""
@@ -1689,7 +1711,7 @@ class SuperBatcher:
                 # same watchdog as the pooled paths (the fetch rides the
                 # pool so the deadline can fire; awaited immediately, so
                 # the partial path stays effectively synchronous)
-                lease = getattr(wire, "_lease", None)
+                lease = _dispatch_lease(wire, batch)
                 try:
                     out = self._watchdog.await_result(
                         self._pool.submit(self._timed_fetch_one, out_dev),
@@ -1702,9 +1724,9 @@ class SuperBatcher:
                         lease.discard()  # wedged dispatch: no reuse
                     self.refund_dispatch()
                     raise
-                if lease is not None:
-                    lease.retire()
                 self.handle(out, batch, t, at_boundary=True)
+                if lease is not None:
+                    lease.retire()  # after the handler — see _emit_one
             return
         # backpressure + timeliness, as in FetchPipeline (the already-done
         # probe is wall-clock-dependent, so deterministic/multi-host mode
@@ -1728,7 +1750,7 @@ class SuperBatcher:
                         depth=len(self._inflight))
         self._inflight.append(
             (self._pool.submit(self._timed_fetch_many, outs, len(group)),
-             group, outs, getattr(wire, "_lease", None))
+             group, outs, _dispatch_lease(wire, *(b for b, _ in group)))
         )
         self._depth_gauge.set(len(self._inflight))
         self._dispatched += len(group)
@@ -1903,11 +1925,15 @@ class FetchPipeline:
             if lease is not None:
                 lease.discard()
             raise
+        self.handle(host, batch, t, at_boundary=not self._pending)
         if lease is not None:
             # fetch delivered ⇒ the dispatch consumed its wire bytes: the
-            # arena lease retires to the pool
+            # arena lease retires to the pool. AFTER the handler — the
+            # lease may chain the batch's featurize-stage arrays (r18),
+            # which delivery handlers still read (tenant re-routing,
+            # per-batch stats), and a prefetching featurize thread must
+            # not be handed the buffer while they do
             lease.retire()
-        self.handle(host, batch, t, at_boundary=not self._pending)
 
     def _drain(self) -> None:
         while self._pending:
@@ -1976,7 +2002,7 @@ class FetchPipeline:
             tr.complete("dispatch", t0, dt, depth=len(self._pending))
         self._pending.append(
             (self._pool.submit(self._timed_fetch, out), out, batch, t,
-             getattr(wire, "_lease", None))
+             _dispatch_lease(wire, batch))
         )
         self._depth_gauge.set(len(self._pending))
         self._dispatched += 1
@@ -2474,7 +2500,7 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
                 )
             else:
                 wire = batch
-            lease = getattr(wire, "_lease", None)
+            lease = _dispatch_lease(wire, batch)
             td = _time.perf_counter()
             _faults.perturb("step")  # --chaos dispatch injection
             out = model.step(wire)
@@ -2494,9 +2520,11 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
             _sideband.record_stage("fetch", dt)
             if tr.enabled:
                 tr.complete("fetch", t0, dt, depth=1)
+            handle(out, batch, t, at_boundary=True)
             if lease is not None:
                 lease.retire()  # synchronous fetch: dispatch consumed it
-            handle(out, batch, t, at_boundary=True)
+                # (after the handler — the lease may chain the batch's
+                # featurize-stage arrays, r18)
 
         stream.foreach_batch(skip_empty(per_batch))
         return (lambda: None), 1

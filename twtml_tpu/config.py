@@ -176,6 +176,14 @@ class ConfArguments:
                 "wireAssemble must be 'auto', 'on' or 'off', got "
                 f"{self.wireAssemble!r}"
             )
+        # one-pass native featurize (r18): the fused C emitter fills the
+        # ragged-wire arrays straight from the batch's columns
+        self.featurizeNative: str = conf.get("featurizeNative", "auto")
+        if self.featurizeNative not in ("auto", "on", "off"):
+            raise ValueError(
+                "featurizeNative must be 'auto', 'on' or 'off', got "
+                f"{self.featurizeNative!r}"
+            )
         self.recycleAfterMb: int = int(conf.get("recycleAfterMb", "0"))
         # elastic lockstep membership (r16): host loss shrinks the fleet
         # instead of aborting it; recovered hosts rejoin at epoch
@@ -569,6 +577,18 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                                                loadable (host-only work, no transport-regime
                                                gate); off = the numpy ground truth.
                                                Default: {self.wireAssemble}
+  --featurizeNative <auto|on|off>              One-pass native featurize (r18): 'on' fills the
+                                               ragged wire's arrays — flat units, padded
+                                               offsets, scaled f32 numeric/label/mask — in ONE
+                                               C sweep (native/featurize.cpp) into a pooled
+                                               arena lease, on both ingest paths (object
+                                               Status batches and parsed blocks). Bit-identical
+                                               batches and trajectories vs the Python ground
+                                               truth (tests/test_featurize_native.py). auto =
+                                               on whenever the native emitter is loadable
+                                               (host-only work, no transport-regime gate);
+                                               off = the Python/numpy ground truth.
+                                               Default: {self.featurizeNative}
 """
 
     def parse(self, args: list[str]) -> "ConfArguments":
@@ -679,6 +699,10 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
         elif flag == "--wireAssemble":
             self.wireAssemble = take()
             if self.wireAssemble not in ("auto", "on", "off"):
+                self.printUsage(1)
+        elif flag == "--featurizeNative":
+            self.featurizeNative = take()
+            if self.featurizeNative not in ("auto", "on", "off"):
                 self.printUsage(1)
         elif flag == "--recycleAfterMb":
             self.recycleAfterMb = int(take())

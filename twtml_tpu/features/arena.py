@@ -72,6 +72,46 @@ class Lease:
             self._arena._retire(self.buf, recycle=False)
 
 
+class LeaseChain:
+    """Several leases retiring/discarding as ONE — the dispatch-site
+    handle for a batch whose wire buffer AND featurize-stage arrays
+    (the one-pass native featurizer, r18) are both arena-leased. The
+    pipelines hold one lease object per in-flight dispatch; chaining
+    keeps that contract while both buffers ride to the same fetch
+    delivery. ``buf`` exposes the primary (wire) buffer so accounting
+    probes keep working."""
+
+    __slots__ = ("leases", "buf")
+
+    def __init__(self, *leases):
+        self.leases = [le for le in leases if le is not None]
+        self.buf = self.leases[0].buf if self.leases else None
+
+    def retire(self) -> None:
+        for le in self.leases:
+            le.retire()
+
+    def discard(self) -> None:
+        for le in self.leases:
+            le.discard()
+
+
+def chain_leases(*leases):
+    """None-safe, identity-deduplicating combinator: the single lease
+    when only one distinct lease is present (the common case — an
+    unpacked dispatch sees the same object through both the wire and the
+    batch), a ``LeaseChain`` otherwise, None for none."""
+    seen: list = []
+    for le in leases:
+        if le is not None and not any(le is s for s in seen):
+            seen.append(le)
+    if not seen:
+        return None
+    if len(seen) == 1:
+        return seen[0]
+    return LeaseChain(*seen)
+
+
 class WireArena:
     """Size-bucketed pool of wire destination buffers (module docstring)."""
 
@@ -84,7 +124,10 @@ class WireArena:
         self.enabled = True
 
     # gauges/counters resolved lazily so importing this module never pulls
-    # the telemetry registry (or anything heavier) at import time
+    # the telemetry registry (or anything heavier) at import time; looked
+    # up per call, NOT cached — reset_for_tests clears the registry in
+    # place, and its contract is exactly that the hot path holds no metric
+    # references across calls
     def _metrics(self):
         from ..telemetry import metrics as _metrics
 

@@ -22,6 +22,7 @@ here because they are plain bugs there:
 
 from __future__ import annotations
 
+import datetime
 import functools
 import inspect
 import itertools
@@ -49,6 +50,11 @@ _NUMERIC_COLS = operator.attrgetter(
     "followers_count", "favourites_count", "friends_count",
     "created_at_ms", "retweet_count",
 )
+# single-attribute getters for the r18 one-traversal gather: list(map(...))
+# runs the extraction at C speed, so the only Python-bytecode loop left on
+# the object featurize path is the filter itself
+_RS_GET = operator.attrgetter("retweeted_status")
+_TEXT_GET = operator.attrgetter("text")
 
 # hand-scaling constants of the reference (MllibHelper.scala:64-67)
 COUNT_SCALE = 1e-12  # followers / favourites / friends
@@ -131,9 +137,9 @@ def _parse_created_at_ms(value: Any) -> int:
         return int(s)
     try:
         # Twitter's format is close enough to RFC 2822 for this parser once
-        # the weekday/month tokens are in the expected order.
-        import datetime
-
+        # the weekday/month tokens are in the expected order (datetime is a
+        # module-scope import: this fallback sits on the hot created_at
+        # path of object ingest, where a per-call import taxed every tweet)
         dt = datetime.datetime.strptime(s, "%a %b %d %H:%M:%S %z %Y")
         return int(dt.timestamp() * 1000)
     except ValueError:
@@ -219,6 +225,12 @@ class Featurizer:
     # sentiment_labels_from_units upcasts internally)
     unit_label_fn: "Callable[[np.ndarray, np.ndarray], np.ndarray] | None" = None
     num_number_features: int = field(default=NUM_NUMBER_FEATURES, init=False)
+    # per-call featurize sub-stage clock [(name, t0, seconds)] — read by
+    # FeatureStream._featurize after each call (telemetry side-channel:
+    # ``featurize.{encode,numeric,wire_build}_ms`` gauges + nested trace
+    # spans, so the straggler ladder can name WHICH half of featurize
+    # gates a host). Three perf_counter reads per BATCH, never per tweet.
+    last_substages: list = field(default_factory=list, init=False, repr=False)
 
     @classmethod
     def from_conf(cls, conf) -> "Featurizer":
@@ -387,11 +399,41 @@ class Featurizer:
         )
         return FeatureBatch(token_idx, token_val, numeric, label, mask)
 
-    def _numeric_label_mask(self, keep, originals, b: int, encoded=None):
-        """Padded numeric/label/mask columns, one attrgetter pass.
-        ``encoded``: the batch's already-computed (units, offsets) of the
-        originals' (lowercased) texts, offered to a batched labeler that
-        accepts it — avoids a second encode pass on the hot path."""
+    def _sub(self, name: str, t0: float) -> float:
+        """Record one featurize sub-stage span; returns the stage end
+        time (the next stage's t0)."""
+        t1 = time.perf_counter()
+        self.last_substages.append((name, t0, t1 - t0))
+        return t1
+
+    def _apply_label_fns(self, label: np.ndarray, keep, encoded) -> bool:
+        """Apply a configured custom labeler over ``label[:n]`` — the ONE
+        definition of the label_fn/batch_label_fn precedence both the
+        numpy ground truth and the fused native path share. Returns False
+        when no custom labeler is set (the default label is the numeric
+        columns' retweet count, filled by whichever path ran)."""
+        n = len(keep)
+        if self.batch_label_fn is not None:
+            if encoded is not None and _accepts_encoded(self.batch_label_fn):
+                label[:n] = self.batch_label_fn(keep, encoded=encoded)
+            else:
+                label[:n] = self.batch_label_fn(keep)
+            return True
+        if self.label_fn is not None:
+            label[:n] = [self.label_fn(s) for s in keep]
+            return True
+        return False
+
+    def _numeric_label_mask(
+        self, keep, originals, b: int, encoded=None, cols=None
+    ):
+        """Padded numeric/label/mask columns. ``cols``: the float64 [n, 5]
+        numeric columns already gathered by ``_gather_rows`` (one Python
+        traversal, r18); None falls back to the attrgetter pass over
+        ``originals``. ``encoded``: the batch's already-computed (units,
+        offsets) of the originals' (lowercased) texts, offered to a
+        batched labeler that accepts it — avoids a second encode pass on
+        the hot path."""
         n = len(keep)
         numeric = np.zeros((b, NUM_NUMBER_FEATURES), dtype=np.float32)
         label = np.zeros((b,), dtype=np.float32)
@@ -399,56 +441,137 @@ class Featurizer:
         if not n:
             return numeric, label, mask
         now = self.now_ms if self.now_ms is not None else int(time.time() * 1000)
-        cols = np.fromiter(
-            itertools.chain.from_iterable(map(_NUMERIC_COLS, originals)),
-            np.float64, n * 5,
-        ).reshape(n, 5)
+        if cols is None:
+            cols = np.fromiter(
+                itertools.chain.from_iterable(map(_NUMERIC_COLS, originals)),
+                np.float64, n * 5,
+            ).reshape(n, 5)
         numeric[:n, :3] = cols[:, :3] * COUNT_SCALE
         numeric[:n, 3] = (now - cols[:, 3]) * AGE_SCALE
-        if self.batch_label_fn is not None:
-            if encoded is not None and _accepts_encoded(self.batch_label_fn):
-                label[:n] = self.batch_label_fn(keep, encoded=encoded)
-            else:
-                label[:n] = self.batch_label_fn(keep)
-        elif self.label_fn is None:
+        if not self._apply_label_fns(label, keep, encoded):
             label[:n] = cols[:, 4]
-        else:
-            label[:n] = [self.label_fn(s) for s in keep]
         mask[:n] = 1.0
         return numeric, label, mask
+
+    def _gather_rows(self, statuses: list[Status], pre_filtered: bool):
+        """ONE Python-level traversal of the Status objects (r18): the
+        filter is the only remaining Python-bytecode loop; texts and the
+        five numeric columns then extract from the kept originals at C
+        speed (``list(map(attrgetter))`` / ``np.fromiter``). The object
+        ingest path previously paid four separate per-tweet Python
+        traversals (the filtrate comprehension with two method calls per
+        row, the originals comprehension, the isascii/lower loop, the
+        attrgetter fromiter) — on the one-core host that WAS the
+        featurize stage (BENCHMARKS r17 → r18).
+
+        Returns (keep, texts, cols float64 [n, 5] in _NUMERIC_COLS
+        order). ``keep`` is the kept Status objects when a custom
+        labeler will need them; with no labeler configured it is the
+        kept ORIGINALS — only its length is read downstream, and
+        skipping the second per-row append is measurable. Texts are the
+        originals' RAW texts — per-text lower()/accent handling stays in
+        ``_encode_batch_texts``. ``filtrate``/``retweet_interval`` are
+        inlined only when not overridden (a subclassed filter keeps its
+        exact semantics at one method call per row); the inlined compare
+        is the same Python-int comparison the ground truth makes."""
+        inline = (
+            type(self).filtrate is Featurizer.filtrate
+            and type(self).retweet_interval is Featurizer.retweet_interval
+        )
+        need_statuses = (
+            self.label_fn is not None or self.batch_label_fn is not None
+        )
+        if pre_filtered:
+            keep: list = statuses
+            rts = list(map(_RS_GET, statuses))
+        elif inline and not need_statuses:
+            nb, ne = self.num_retweet_begin, self.num_retweet_end
+            rts = []
+            ra = rts.append
+            for s in statuses:
+                rs = s.retweeted_status
+                if rs is not None and nb <= rs.retweet_count <= ne:
+                    ra(rs)
+            keep = rts  # length-only sentinel (no labeler reads it)
+        else:
+            nb, ne = self.num_retweet_begin, self.num_retweet_end
+            keep = []
+            rts = []
+            ka, ra = keep.append, rts.append
+            if inline:
+                for s in statuses:
+                    rs = s.retweeted_status
+                    if rs is not None and nb <= rs.retweet_count <= ne:
+                        ka(s)
+                        ra(rs)
+            else:
+                for s in statuses:
+                    if self.filtrate(s):
+                        ka(s)
+                        ra(s.retweeted_status)
+        n = len(rts)
+        texts = list(map(_TEXT_GET, rts))
+        # float64 conversion from the Python ints in one C pass — the
+        # exact conversion the pre-r18 fromiter ground truth performed
+        # (the parity law's numeric columns)
+        cols = np.fromiter(
+            itertools.chain.from_iterable(map(_NUMERIC_COLS, rts)),
+            np.float64, n * 5,
+        ).reshape(n, 5)
+        return keep, texts, cols
 
     def _encode_batch_texts(self, statuses: list[Status], pre_filtered: bool):
         """Shared filter + UTF-16 encode for the unit-wire builders
         (padded ``featurize_batch_units`` and ragged
         ``featurize_batch_ragged``): returns
-        (keep, originals, units, offsets, lengths, all_ascii)."""
+        (keep, cols, units, offsets, all_ascii) — ``cols`` the float64
+        [n, 5] numeric columns from the same single Status traversal
+        (``_gather_rows``)."""
         from . import native
 
-        keep = (
-            statuses if pre_filtered
-            else [s for s in statuses if self.filtrate(s)]
-        )
-        originals = [s.retweeted_status for s in keep]
+        keep, texts, cols = self._gather_rows(statuses, pre_filtered)
         if self.normalize_accents:
-            texts = [_strip_accents(o.text.lower()) for o in originals]
+            texts = [_strip_accents(t.lower()) for t in texts]
             all_ascii = all(t.isascii() for t in texts)
-        else:
-            # case-folding strategy: texts with non-ASCII chars need
-            # Python's Unicode lower(); pure-ASCII texts (the common case)
-            # are folded for free later — during the pad copy (padded wire)
-            # or on device (ragged wire); re-folding the pre-lowered rows'
-            # ASCII range is idempotent
-            all_ascii = True
-            texts = []
-            for o in originals:
-                t = o.text
-                if not t.isascii():
-                    t = t.lower()
-                    all_ascii = False
-                texts.append(t)
-        units, offsets = native.encode_texts(texts)  # pure numpy, C-free
-        lengths = np.diff(offsets).astype(np.int32)
-        return keep, originals, units, offsets, lengths, all_ascii
+            units, offsets = native.encode_texts(texts)
+            return keep, cols, units, offsets, all_ascii
+        # case-folding strategy: texts with non-ASCII chars need Python's
+        # Unicode lower(); pure-ASCII texts (the common case) are folded
+        # for free later — during the pad copy (padded wire) or on device
+        # (ragged wire); re-folding the pre-lowered rows' ASCII range is
+        # idempotent. The ascii probe is ONE C scan of the joined batch
+        # text, and on the all-ASCII batch the probe's join IS the encode
+        # join (one unit per char — the same split encode_texts computes)
+        joined = "".join(texts)
+        if not joined.isascii():
+            texts = [t if t.isascii() else t.lower() for t in texts]
+            units, offsets = native.encode_texts(texts)
+            return keep, cols, units, offsets, False
+        units = np.frombuffer(
+            joined.encode("utf-16-le", "surrogatepass"), dtype=np.uint16
+        )
+        n = len(texts)
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(
+            np.fromiter(map(len, texts), np.int64, n), out=offsets[1:]
+        )
+        if units.size == 0:
+            units = np.zeros(1, dtype=np.uint16)
+        return keep, cols, units, offsets, True
+
+    @staticmethod
+    def _row_len_bucket(max_len: int, unit_bucket: int) -> int:
+        """The padded row length L for a given max row length — the ONE
+        bucket policy both unit wires and the fused native path share.
+        L ≥ 2 so the device's [:, :-1]/[:, 1:] bigram windows are
+        non-empty."""
+        from .batch import _bucket
+
+        return (
+            unit_bucket
+            if unit_bucket >= max(max_len, 2) and unit_bucket > 0
+            else _bucket(max(max_len, 2))
+        )
 
     @staticmethod
     def _unit_batch_shape(
@@ -456,18 +579,12 @@ class Featurizer:
     ) -> tuple[int, int]:
         """The ONE (padded rows, padded row length) policy for both unit
         wires — padded and ragged MUST agree on compile shapes or the
-        bit-identical-features contract drifts. L ≥ 2 so the device's
-        [:, :-1]/[:, 1:] bigram windows are non-empty."""
-        from .batch import _bucket, pad_row_count
+        bit-identical-features contract drifts."""
+        from .batch import pad_row_count
 
         max_len = int(lengths.max()) if n else 0
         b = pad_row_count(n, row_bucket, row_multiple)
-        lu = (
-            unit_bucket
-            if unit_bucket >= max(max_len, 2) and unit_bucket > 0
-            else _bucket(max(max_len, 2))
-        )
-        return b, lu
+        return b, Featurizer._row_len_bucket(max_len, unit_bucket)
 
     def featurize_batch_ragged(
         self,
@@ -486,23 +603,59 @@ class Featurizer:
         padded paths (differential tests in tests/test_ragged_wire.py).
         ``unit_bucket`` still pins the REBUILT row length L (compile-shape
         discipline); only the wire stops paying for padding."""
-        from .batch import RaggedUnitBatch, ragged_wire_arrays
+        from .batch import RaggedUnitBatch, pad_row_count, ragged_wire_arrays
 
-        keep, originals, units, offsets, lengths, all_ascii = (
+        self.last_substages = []
+        t0 = time.perf_counter()
+        keep, cols, units, offsets, all_ascii = (
             self._encode_batch_texts(statuses, pre_filtered)
         )
+        t0 = self._sub("encode", t0)
         n = len(keep)
-        b, lu = self._unit_batch_shape(
-            n, lengths, row_bucket, unit_bucket, row_multiple
-        )
-        # narrow uint8 wire iff every row is ASCII — same metadata gate as
-        # the padded wire (_pad_ragged_units); the downcast is lossless then
-        flat, offs = ragged_wire_arrays(units, offsets, n, b, narrow=all_ascii)
+        b = pad_row_count(n, row_bucket, row_multiple)
         enc = (units, offsets) if not self.normalize_accents else None
-        numeric, label, mask = self._numeric_label_mask(
-            keep, originals, b, encoded=enc
+        # one-pass native fast path (r18, --featurizeNative): ONE C sweep
+        # emits the final ragged-wire arrays — flat units (narrow uint8
+        # iff every row is ASCII, the same metadata gate as the padded
+        # wire), padded int32 offsets, scaled f32 numeric/label/mask —
+        # into one arena lease; None falls through to the ground truth
+        from . import featurize_native as _ffz
+
+        fast = _ffz.try_fill(
+            units, offsets, cols, _ffz.object_col_order(), n, b,
+            narrow=all_ascii,
+            now_ms=(
+                self.now_ms if self.now_ms is not None
+                else int(time.time() * 1000)
+            ),
         )
-        batch = RaggedUnitBatch(flat, offs, numeric, label, mask, row_len=lu)
+        if fast is not None:
+            flat, offs, numeric, label, mask, max_len, lease = fast
+            t0 = self._sub("wire_build", t0)
+            if n:
+                self._apply_label_fns(label, keep, enc)
+            self._sub("numeric", t0)
+            batch = RaggedUnitBatch(
+                flat, offs, numeric, label, mask,
+                row_len=self._row_len_bucket(max_len, unit_bucket),
+            )
+            _ffz.attach_lease(batch, lease)
+        else:
+            lengths = np.diff(offsets).astype(np.int32)
+            lu = self._row_len_bucket(
+                int(lengths.max()) if n else 0, unit_bucket
+            )
+            flat, offs = ragged_wire_arrays(
+                units, offsets, n, b, narrow=all_ascii
+            )
+            t0 = self._sub("wire_build", t0)
+            numeric, label, mask = self._numeric_label_mask(
+                keep, None, b, encoded=enc, cols=cols
+            )
+            self._sub("numeric", t0)
+            batch = RaggedUnitBatch(
+                flat, offs, numeric, label, mask, row_len=lu
+            )
         if pack:
             # one-buffer wire (+11.4% paired through the tunnel) for callers
             # that feed the model directly; apps keep the unpacked batch for
@@ -528,22 +681,28 @@ class Featurizer:
         features bit-identical to `featurize_batch`'s. Host cost per batch
         drops to one encode + one vectorized pad — no per-bigram work at all.
         """
-        keep, originals, units, offsets, lengths, all_ascii = (
+        self.last_substages = []
+        t0 = time.perf_counter()
+        keep, cols, units, offsets, all_ascii = (
             self._encode_batch_texts(statuses, pre_filtered)
         )
+        t0 = self._sub("encode", t0)
         n = len(keep)
+        lengths = np.diff(offsets).astype(np.int32)
         b, lu = self._unit_batch_shape(
             n, lengths, row_bucket, unit_bucket, row_multiple
         )
         buf, length = _pad_ragged_units(
             units, offsets, lengths, n, b, lu, narrow=all_ascii
         )
+        t0 = self._sub("wire_build", t0)
         # the encode is reusable by a batched labeler only when it reflects
         # the plain lowercased text (accent stripping changes the tokens)
         enc = (units, offsets) if not self.normalize_accents else None
         numeric, label, mask = self._numeric_label_mask(
-            keep, originals, b, encoded=enc
+            keep, None, b, encoded=enc, cols=cols
         )
+        self._sub("numeric", t0)
         return UnitBatch(buf, length, numeric, label, mask)
 
     def featurize_parsed_block(
@@ -581,7 +740,53 @@ class Featurizer:
                 "(Status-based label_fn/batch_label_fn need the object "
                 "ingest path)"
             )
+        self.last_substages = []
+        t0 = time.perf_counter()
         n = block.rows
+        # one-pass native fast path (r18, --featurizeNative): in the
+        # common case — ragged wire, every row parser-ASCII-flagged (so
+        # no Unicode redo round-trip exists), no accent stripping — ONE C
+        # sweep emits the final wire arrays from the parser's columns
+        # (int64 → float64 scale, bit-matching the astype ground truth)
+        # into one arena lease, and the stage runs no numpy passes at all
+        if (
+            ragged
+            and not self.normalize_accents
+            and (n == 0 or not bool((np.asarray(block.ascii) == 0).any()))
+        ):
+            from . import featurize_native as _ffz
+            from .batch import (
+                RaggedUnitBatch as _RB,
+                pack_batch as _pack_batch,
+                pad_row_count as _pad_row_count,
+            )
+
+            t0 = self._sub("encode", t0)  # the ascii probe IS the text prep
+            b = _pad_row_count(n, row_bucket, row_multiple)
+            fast = _ffz.try_fill(
+                block.units, block.offsets, block.numeric,
+                _ffz.block_col_order(), n, b, narrow=True,
+                now_ms=(
+                    self.now_ms if self.now_ms is not None
+                    else int(time.time() * 1000)
+                ),
+            )
+            if fast is not None:
+                flat, offs, numeric, label, mask, max_len, lease = fast
+                t0 = self._sub("wire_build", t0)
+                if n and self.unit_label_fn is not None:
+                    # labels from the ORIGINAL raw units, like the ground
+                    # truth below
+                    label[:n] = self.unit_label_fn(
+                        block.units, block.offsets
+                    )
+                self._sub("numeric", t0)
+                batch = _RB(
+                    flat, offs, numeric, label, mask,
+                    row_len=self._row_len_bucket(max_len, unit_bucket),
+                )
+                _ffz.attach_lease(batch, lease)
+                return _pack_batch(batch) if pack else batch
         units, offsets = block.units, block.offsets.copy()
         redo = (
             np.arange(n)
@@ -627,6 +832,7 @@ class Featurizer:
                 np.cumsum(new_lens, out=offsets[1:])
             else:
                 units = new_units
+        t0 = self._sub("encode", t0)
         lengths = np.diff(offsets).astype(np.int32)
         b, lu = self._unit_batch_shape(
             n, lengths, row_bucket, unit_bucket, row_multiple
@@ -655,6 +861,7 @@ class Featurizer:
             else:
                 label[:n] = cols64[:, COL_LABEL]
             mask[:n] = 1.0
+        t0 = self._sub("numeric", t0)
         if ragged:
             # the block ALREADY holds concatenated units + offsets — the
             # ragged wire ships them as-is (no pad copy at all); the jit
@@ -666,8 +873,10 @@ class Featurizer:
             batch = RaggedUnitBatch(
                 flat, offs, numeric, label, mask, row_len=lu
             )
+            self._sub("wire_build", t0)
             return pack_batch(batch) if pack else batch
         buf, length = _pad_ragged_units(
             units, offsets, lengths, n, b, lu, narrow=narrow
         )
+        self._sub("wire_build", t0)
         return UnitBatch(buf, length, numeric, label, mask)

@@ -26,6 +26,7 @@ _SRCS = [
     os.path.join(_REPO_ROOT, "native", "tweetjson.cpp"),
     os.path.join(_REPO_ROOT, "native", "wirecodec.cpp"),
     os.path.join(_REPO_ROOT, "native", "wireassemble.cpp"),
+    os.path.join(_REPO_ROOT, "native", "featurize.cpp"),
 ]
 # TWTML_NATIVE_LIB: alternate build/load path for the shared library. The
 # sanitizer harness (tools/native_sanity.py) builds an ASan/UBSan-
@@ -97,6 +98,11 @@ _codec_missing = False
 # ``native.assemble_degraded`` counter — and every pack falls back to the
 # byte-identical numpy pipeline (features/batch.py, the ground truth)
 _assemble_missing = False
+# and for the one-pass featurize emitter (r18): a stale library missing
+# ``featurize_wire`` only flags this — one warning + the
+# ``native.featurize_degraded`` counter — and the featurizer keeps
+# running on the byte-identical Python/numpy path (the ground truth)
+_featurize_missing = False
 
 
 def _build() -> bool:
@@ -246,6 +252,7 @@ def _load(path: str, strict: bool = True) -> ctypes.CDLL:
     _bind_wire(lib, strict)
     _bind_codec(lib, strict)
     _bind_assemble(lib, strict)
+    _bind_featurize(lib, strict)
     return lib
 
 
@@ -370,6 +377,129 @@ def _bind_assemble(lib: ctypes.CDLL, strict: bool) -> None:
     _assemble_missing = False
 
 
+def _bind_featurize(lib: ctypes.CDLL, strict: bool) -> None:
+    """Bind the one-pass featurize emitter (native/featurize.cpp). Same
+    degrade contract as its siblings: strict loads raise (get_lib
+    rebuilds), degraded loads flag ``_featurize_missing`` ONCE — warning
+    + ``native.featurize_degraded`` counter — and the featurizer keeps
+    running on the byte-identical Python/numpy ground truth."""
+    global _featurize_missing
+    try:
+        fn = lib.featurize_wire
+    except AttributeError:
+        if strict:
+            raise
+        _featurize_missing = True
+        log.warning(
+            "native library is stale: featurize_wire missing — featurize "
+            "uses the Python/numpy path (delete native/libfasthash.so to "
+            "force a rebuild of the one-pass featurize emitter)"
+        )
+        from ..telemetry import metrics as _metrics
+
+        _metrics.get_registry().counter("native.featurize_degraded").inc()
+        return
+    fn.restype = ctypes.c_int64
+    # every pointer is c_void_p on purpose: the wrapper passes raw
+    # ``arr.ctypes.data`` integers — ``data_as`` casts measured ~7 µs
+    # EACH and this entry runs per batch on the featurize hot path
+    fn.argtypes = [
+        ctypes.c_void_p,  # units
+        ctypes.c_int64,  # unit_size
+        ctypes.c_void_p,  # offsets [n+1] int64
+        ctypes.c_void_p,  # cols_f64 [n,5] or None
+        ctypes.c_void_p,  # cols_i64 [n,5] or None
+        ctypes.c_void_p,  # col_order [5] int64
+        ctypes.c_int64,  # n
+        ctypes.c_int64,  # b
+        ctypes.c_int64,  # n_bucket
+        ctypes.c_int64,  # now_ms
+        ctypes.c_int64,  # narrow
+        ctypes.c_void_p,  # out_units
+        ctypes.c_void_p,  # out_offsets [b+1] int32
+        ctypes.c_void_p,  # out_numeric [b,4] f32
+        ctypes.c_void_p,  # out_label [b] f32
+        ctypes.c_void_p,  # out_mask [b] f32
+    ]
+    _featurize_missing = False
+
+
+def featurize_available() -> bool:
+    """Whether the one-pass featurize emitter is loadable (library up and
+    the symbol present — see _bind_featurize's degrade seam)."""
+    return get_lib() is not None and not _featurize_missing
+
+
+def featurize_wire_raw(*args) -> "int | None":
+    """Raw-pointer form of the one-pass featurize entry: ``args`` are
+    exactly the C signature's 16 values with every pointer as a plain
+    int (or None). The hot caller (features/featurize_native.try_fill)
+    computes its five output pointers from the ONE lease base address —
+    each numpy ``.ctypes`` access builds an interface object (~2-3 µs)
+    and this entry runs per batch. Returns the max row length, or None
+    when the library is unavailable, predates the emitter, or refuses
+    the input — callers fall back to the Python/numpy ground truth."""
+    lib = get_lib()
+    if lib is None or _featurize_missing:
+        return None
+    max_len = lib.featurize_wire(*args)
+    if max_len < 0:  # caller sized n_bucket from these offsets; never expected
+        return None
+    return int(max_len)
+
+
+def featurize_wire(
+    units: np.ndarray,
+    offsets: np.ndarray,
+    cols: np.ndarray,
+    col_order: np.ndarray,
+    n: int,
+    b: int,
+    n_bucket: int,
+    now_ms: int,
+    narrow: bool,
+    out_units: np.ndarray,
+    out_offsets: np.ndarray,
+    out_numeric: np.ndarray,
+    out_label: np.ndarray,
+    out_mask: np.ndarray,
+) -> "int | None":
+    """One C pass from encoded units + numeric columns to the final
+    ragged-wire arrays (native/featurize.cpp): flat units (narrow uint8
+    under the caller's metadata gate), padded int32 offsets, scaled f32
+    numeric/label/mask — all written into the caller's (arena-leased)
+    destinations. ``cols`` is float64 [n, 5] (object path) or int64
+    [n, 5] (block parser columns); ``col_order`` maps its layout onto
+    followers/favourites/friends/created_ms/label. Array-argument
+    convenience form of ``featurize_wire_raw`` (same contract)."""
+    if cols.dtype == np.float64:
+        cols_f64, cols_i64 = cols.ctypes.data, None
+    elif cols.dtype == np.int64:
+        cols_f64, cols_i64 = None, cols.ctypes.data
+    elif n:
+        return None
+    else:
+        cols_f64 = cols_i64 = None
+    return featurize_wire_raw(
+        units.ctypes.data,
+        int(units.dtype.itemsize),
+        offsets.ctypes.data,
+        cols_f64,
+        cols_i64,
+        col_order.ctypes.data,
+        n,
+        b,
+        n_bucket,
+        int(now_ms),
+        1 if narrow else 0,
+        out_units.ctypes.data,
+        out_offsets.ctypes.data,
+        out_numeric.ctypes.data,
+        out_label.ctypes.data,
+        out_mask.ctypes.data,
+    )
+
+
 def assemble_available() -> bool:
     """Whether the fused wire assembler is loadable (library up and the
     symbol present — see _bind_assemble's degrade seam)."""
@@ -464,6 +594,23 @@ def digram_encode(buf: np.ndarray, lut: np.ndarray) -> "np.ndarray | None":
     if m < 0:  # cannot happen with cap = n; be loud if it ever does
         raise RuntimeError("digram_encode overflowed its full-size buffer")
     return out[:m].copy()
+
+
+def rebind_flags() -> None:
+    """Re-evaluate EVERY degrade flag against the real loaded library.
+    Test support for the stale-library seam tests: ``_load(path,
+    strict=False)`` on an old .so flags every symbol that .so lacks —
+    the module-global flags are shared with the production library, so
+    a seam test restoring only ITS OWN flag leaves the younger fast
+    paths silently degraded for the rest of the process (found by r18's
+    lease-accounting tests: the r9 stale test left the r15/r17/r18
+    paths off for the remainder of tier-1)."""
+    lib = get_lib()
+    if lib is not None:
+        _bind_wire(lib, strict=False)
+        _bind_codec(lib, strict=False)
+        _bind_assemble(lib, strict=False)
+        _bind_featurize(lib, strict=False)
 
 
 def available() -> bool:

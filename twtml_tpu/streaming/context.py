@@ -343,6 +343,7 @@ class FeatureStream(RawStream):
         if not tr.enabled:
             batch = self._featurize_impl(statuses)
             _sideband.record_stage("featurize", time.perf_counter() - t0)
+            self._record_substages(None)
             return self._poison_gate(statuses, batch)
         with tr.span("featurize", items=len(statuses)) as sp:
             batch = self._featurize_impl(statuses)
@@ -354,7 +355,29 @@ class FeatureStream(RawStream):
                 wire_bytes=wire_nbytes(batch),
             )
         _sideband.record_stage("featurize", time.perf_counter() - t0)
+        self._record_substages(tr)
         return self._poison_gate(statuses, batch)
+
+    def _record_substages(self, tr) -> None:
+        """The featurize sub-stage clock (r18): per-batch encode /
+        numeric / wire_build durations recorded by the featurizer
+        (featurizer.last_substages) become ``featurize.<name>_ms``
+        gauges on /api/metrics — so the straggler ladder can name WHICH
+        half of featurize gates a host — and, under ``--trace``, nested
+        ``featurize.<name>`` complete-events inside the featurize span.
+        Telemetry side-channel only: host clock reads, zero added
+        fetches (the gauges never touch a device array)."""
+        subs = getattr(self.featurizer, "last_substages", None)
+        if not subs:
+            return
+        agg: "dict[str, float]" = {}
+        for name, sub_t0, dur in subs:
+            agg[name] = agg.get(name, 0.0) + dur
+            if tr is not None:
+                tr.complete("featurize." + name, sub_t0, dur)
+        reg = _metrics.get_registry()
+        for name, dur in agg.items():
+            reg.gauge(f"featurize.{name}_ms").set(round(dur * 1e3, 4))
 
     @staticmethod
     def _poison_gate(statuses: list, batch):
