@@ -70,6 +70,13 @@ def dashboard(defer_series=False):
         "jsonClass": "Fleet", "policy": "", "replicas": [], "requests": 0,
         "retries": 0, "ejections": 0, "champion": -1,
     }
+    h.fetch_routes["/api/freshness"] = {
+        "jsonClass": "Freshness", "batches": 0, "rows": 0, "eventLagMs": -1.0,
+        "eventLagP50Ms": -1.0, "eventLagP95Ms": -1.0, "eventLagP99Ms": -1.0,
+        "publishLagP95Ms": -1.0, "watermarkLagMs": -1.0, "watermark": [],
+        "critical": "", "criticalTicks": {}, "sloMs": 0.0, "breachRun": 0,
+        "breaches": 0,
+    }
     series = h.defer("/api/series") if defer_series else None
     if not defer_series:
         h.fetch_routes["/api/series"] = []
@@ -521,6 +528,117 @@ def test_fleet_empty_view_is_placeholder():
     assert h.el("fleetRetries").text == "0"
     assert "degraded" not in h.el("fleetRetries").class_set
     assert h.el("fleetPanel").children == []
+
+
+# ---------------------------------------------------------------------------
+# freshness plane tiles (ISSUE 16, mirrors the Serving suite)
+
+def test_freshness_frame_updates_tiles_and_sparkline():
+    """Freshness tiles: event-lag percentiles, publish lag, watermark lag,
+    the dominant critical-path edge, breach highlight, and the watermark
+    sparkline drawn from the rolling window."""
+    h = dashboard()
+    h.ws.server_open()
+    ctx = h.el("freshSpark").ctx
+    ctx.calls.clear()
+    h.ws.server_message(frame(
+        jsonClass="Freshness", batches=42, rows=84000, eventLagMs=812.0,
+        eventLagP50Ms=640.4, eventLagP95Ms=812.6, eventLagP99Ms=1500.0,
+        publishLagP95Ms=990.0, watermarkLagMs=870.0,
+        watermark=[800.0, 850.0, 870.0], critical="dispatch",
+        criticalTicks={"dispatch": 30, "parse": 12}, sloMs=0.0,
+        breachRun=0, breaches=2,
+    ))
+    assert h.el("freshP50").text == "640"
+    assert h.el("freshP95").text == "813"
+    assert h.el("freshP99").text == "1500"
+    assert h.el("freshPublish").text == "990"
+    assert h.el("freshWatermark").text == "870"
+    assert h.el("freshCritical").text == "dispatch"
+    assert h.el("freshBreaches").text == "2"
+    assert "degraded" in h.el("freshBreaches").class_set
+    assert len(ctx.ops("stroke")) == 1
+    assert len(ctx.ops("lineTo")) == 2  # 3 points: 1 moveTo + 2 lineTo
+    texts = [args[0] for op, args in ctx.ops("fillText")]
+    assert any("870" in t for t in texts)  # last watermark lag labeled
+    # a breach-free frame clears the highlight
+    h.ws.server_message(frame(
+        jsonClass="Freshness", batches=43, rows=86000, eventLagMs=700.0,
+        eventLagP50Ms=640.0, eventLagP95Ms=810.0, eventLagP99Ms=1400.0,
+        publishLagP95Ms=980.0, watermarkLagMs=860.0, watermark=[860.0],
+        critical="parse", criticalTicks={"parse": 13}, sloMs=0.0,
+        breachRun=0, breaches=0,
+    ))
+    assert h.el("freshCritical").text == "parse"
+    assert "degraded" not in h.el("freshBreaches").class_set
+
+
+def test_freshness_empty_view_is_placeholder():
+    h = dashboard()
+    h.ws.server_open()
+    ctx = h.el("freshSpark").ctx
+    ctx.calls.clear()
+    h.ws.server_message(frame(
+        jsonClass="Freshness", batches=0, rows=0, eventLagMs=-1.0,
+        eventLagP50Ms=-1.0, eventLagP95Ms=-1.0, eventLagP99Ms=-1.0,
+        publishLagP95Ms=-1.0, watermarkLagMs=-1.0, watermark=[],
+        critical="", criticalTicks={}, sloMs=0.0, breachRun=0, breaches=0,
+    ))
+    assert h.el("freshP95").text == "—"
+    assert h.el("freshWatermark").text == "—"
+    assert h.el("freshCritical").text == "—"
+    assert h.el("freshBreaches").text == "0"
+    assert len(ctx.ops("stroke")) == 0
+    texts = [args[0] for op, args in ctx.ops("fillText")]
+    assert any("waiting" in t for t in texts)
+
+
+def test_serving_frame_updates_snapshot_age_tile():
+    """ISSUE 16 serving staleness: snapshotAgeS renders next to the
+    snapshot id; a frame without it (legacy sender) shows the placeholder."""
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="Serving", qps=10.0, rowsPerSec=160.0, p50Ms=5.0,
+        p95Ms=9.0, p99Ms=12.0, snapshotAgeS=37.4, snapshotStep=8,
+        level="ok", requests=50, rows=800, errors=0, tenants=[],
+    ))
+    assert h.el("serveAge").text == "37"
+    # no snapshot yet → placeholder regardless of the age field
+    h.ws.server_message(frame(
+        jsonClass="Serving", qps=0.0, rowsPerSec=0.0, p50Ms=0.0, p95Ms=0.0,
+        p99Ms=0.0, snapshotAgeS=-1.0, snapshotStep=-1, level="",
+        requests=0, rows=0, errors=0, tenants=[],
+    ))
+    assert h.el("serveAge").text == "—"
+
+
+def test_metrics_frame_updates_ingest_lag_and_rss_slope_tiles():
+    """ISSUE 16 satellites: the sampled ingest event-time lag (ms → s) and
+    the continuous RSS-slope gauge render on the pipeline panel; a frame
+    without the lag gauge keeps the placeholder."""
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="Metrics", counters={},
+        gauges={"ingest.event_time_lag_ms": 2500.0,
+                "host.rss_slope_mb_per_min": 1.257},
+        health={"phase": "healthy", "rtt_ms": 70.0, "transitions": 0},
+    ))
+    assert h.el("ingestLag").text == "2.5"
+    assert h.el("rssSlope").text == "1.26"
+    h.ws.server_message(frame(
+        jsonClass="Metrics", counters={}, gauges={},
+        health={"phase": "healthy", "rtt_ms": 70.0, "transitions": 0},
+    ))
+    assert h.el("ingestLag").text == "—"
+    assert h.el("rssSlope").text == "0.00"
+
+
+def test_freshness_backfill_fetched_on_boot():
+    h = dashboard()
+    urls = [u for u, _ in h.fetches]
+    assert "/api/freshness" in urls
 
 
 def test_unknown_jsonclass_is_ignored():

@@ -1,0 +1,148 @@
+"""Freshness-plane overhead check (ISSUE 16): the full --freshness plane —
+per-batch lineage records opened at featurize, FIFO-matched through
+dispatch, enriched at delivery, folded into the watermark/percentile
+windows, publish-lag stamps drained per stats tick — measured against a
+``--freshness off`` control in the per-batch-telemetry regime (the regime
+where per-batch host costs bind; BENCHMARKS.md).
+
+Arms (interleaved single passes + paired per-round ratios, the house
+method — tools/pairedbench.py):
+
+- off   : ``freshness.configure(on=False)`` — every seam call no-ops, the
+          exact HEAD hot path (the bit-parity arm);
+- fresh : ``configure(on=True)`` + one lineage.open_batch per batch, the
+          pipeline's own mark_dispatch at dispatch, and one
+          record_delivery + periodic record_publish per delivered batch
+          (the full delivered-batch cost of the plane).
+
+Both arms dispatch the SAME model/program — the plane is host-side only
+(zero added fetches, zero device traffic), so any delta is pure Python
+bookkeeping. Passes the acceptance gate when the paired ratio (off/fresh)
+is >= 0.97x (the ISSUE's <= 3% budget).
+
+Usage: python tools/bench_freshness.py [--tweets N] [--batch B]
+          [--budget S]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    n_tweets, batch, budget = 65536, 2048, 120.0
+    i = 0
+    while i < len(args):
+        if args[i] == "--tweets":
+            n_tweets = int(args[i + 1]); i += 2
+        elif args[i] == "--batch":
+            batch = int(args[i + 1]); i += 2
+        elif args[i] == "--budget":
+            budget = float(args[i + 1]); i += 2
+        else:
+            raise SystemExit(f"unknown flag {args[i]!r}")
+
+    import jax
+
+    from twtml_tpu.apps.common import FetchPipeline
+    from twtml_tpu.features.batch import pack_batch
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.models import StreamingLinearRegressionWithSGD
+    from twtml_tpu.streaming.sources import SyntheticSource
+    from twtml_tpu.telemetry import freshness as _freshness
+    from twtml_tpu.telemetry import lineage as _lineage
+
+    feat = Featurizer(now_ms=1785320000000)
+    statuses = list(SyntheticSource(total=n_tweets, seed=3).produce())
+    chunks = [statuses[i : i + batch] for i in range(0, len(statuses), batch)]
+    r_batches = [
+        feat.featurize_batch_ragged(c, row_bucket=batch, pre_filtered=True)
+        for c in chunks
+    ]
+    # synthetic statuses carry created_at_ms=0 (no event time): stamp one
+    # AFTER featurizing (features untouched) so the fresh arm pays the real
+    # lag-fold cost — percentile windows, watermark floor, edge argmax
+    for j, s in enumerate(statuses):
+        s.created_at_ms = 1785320000000 + j
+
+    def consume_off(out, b, t, at_boundary=True):
+        float(out.count); float(out.mse)
+        float(out.real_stdev); float(out.pred_stdev)
+        _ = out.predictions[0]
+
+    # stats ticks run every batch in the telemetry regime; drain the
+    # publish-lag stamps at the same cadence the session publisher would
+    def consume_fresh(out, b, t, at_boundary=True):
+        consume_off(out, b, t, at_boundary)
+        _freshness.record_delivery()
+        _freshness.record_publish()
+
+    model = StreamingLinearRegressionWithSGD()
+    seen = set()
+    for rb in r_batches:  # warm every packed layout both arms dispatch
+        key = (rb.units.shape, str(rb.units.dtype), rb.row_len)
+        if key not in seen:
+            seen.add(key)
+            float(model.step(pack_batch(rb)).mse)
+
+    def run_pass(consume, open_lineage):
+        model.reset()
+        t0 = time.perf_counter()
+        pipe = FetchPipeline(model, consume, depth=8, pack=True)
+        for statuses_chunk, rb in zip(chunks, r_batches):
+            if open_lineage:
+                # the featurize-open seam (streaming/context.py); dispatch
+                # marking rides FetchPipeline.on_batch itself
+                _lineage.open_batch(statuses_chunk)
+            pipe.on_batch(rb, 0.0)
+        pipe.flush()
+        return time.perf_counter() - t0
+
+    def off_pass():
+        _freshness.configure(on=False)
+        return run_pass(consume_off, open_lineage=False)
+
+    def fresh_pass():
+        _freshness.reset_for_tests()  # fresh windows per pass
+        _freshness.configure(on=True)
+        return run_pass(consume_fresh, open_lineage=True)
+
+    off_pass(); fresh_pass()  # warm both arms' code paths
+
+    from tools.pairedbench import (
+        best_median_rate, paired_ratio_median, run_rounds,
+    )
+
+    times = run_rounds({"off": off_pass, "fresh": fresh_pass}, budget)
+    view = _freshness.last_freshness() or {}
+    _freshness.configure(on=False)
+    out = {
+        "regime": "freshness-overhead", "batch": batch,
+        "tweets": n_tweets, "backend": jax.default_backend(),
+        "rounds": len(times["off"]),
+        "last_event_lag_p95_ms": view.get("eventLagP95Ms", -1.0),
+        "last_critical": view.get("critical", ""),
+    }
+    for name, ts in times.items():
+        best, median = best_median_rate(ts, n_tweets)
+        out[name] = {
+            "tweets_per_sec_best": best,
+            "tweets_per_sec_median": median,
+        }
+    out["fresh"]["paired_vs_off"] = paired_ratio_median(
+        times["off"], times["fresh"]
+    )
+    out["neutral"] = out["fresh"]["paired_vs_off"] >= 0.97
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

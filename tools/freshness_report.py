@@ -1,0 +1,157 @@
+"""Render the freshness plane's story from a crash flight-recorder bundle
+(telemetry/blackbox.py): how stale the data was end to end (event-time lag
+percentiles from tweet ``created_at_ms`` to fetch delivery, the low
+watermark), which seam-to-seam edge dominated the critical path, whether the
+``--freshnessSloMs`` / ``--servingStaleSloS`` gates fired on the way down,
+and how fast host RSS was growing — the "was the pipeline keeping up?"
+post-mortem an on-call engineer asks first.
+
+Everything rendered here was already IN the bundle: the freshness gauges and
+critical-path counters ride the metrics-registry snapshot the recorder dumps,
+and the SLO breach episodes are blackbox events — this tool adds zero
+instrumentation, it only reads (the ISSUE 16 law: observability at zero
+added fetches).
+
+Exit status is a CHECK, exactly like tools/postmortem_report.py (whose
+bundle validity contract is IMPORTED, so the two tools can never disagree
+on well-formedness): 0 = a well-formed bundle, freshness telemetry present
+or not; 2 = malformed. ``--json`` emits the summary as one machine-readable
+line.
+
+Usage: python tools/freshness_report.py BUNDLE.json [--json]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+try:  # runnable both as a module and as a script
+    from tools.postmortem_report import MalformedBundle, load_bundle
+except ImportError:  # pragma: no cover - script mode from repo root
+    import os
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from tools.postmortem_report import MalformedBundle, load_bundle
+
+# blackbox event kinds the two SLO planes emit (telemetry/freshness.py,
+# serving/plane.py) — the report's breach-episode tail filters on these
+BREACH_KINDS = ("freshness_slo_breach", "serving_stale_breach")
+
+CRITICAL_PREFIX = "freshness.critical."
+
+
+def summarize(doc: dict, tail_events: int = 8) -> dict:
+    metrics = doc.get("metrics") or {}
+    gauges = metrics.get("gauges") or {}
+    counters = metrics.get("counters") or {}
+    hists = metrics.get("histograms") or {}
+    critical = {
+        k[len(CRITICAL_PREFIX):-len(".ticks")]: int(v)
+        for k, v in counters.items()
+        if k.startswith(CRITICAL_PREFIX) and k.endswith(".ticks")
+    }
+    breaches = [
+        e for e in doc.get("events", [])
+        if isinstance(e, dict) and e.get("kind") in BREACH_KINDS
+    ]
+    lag_hist = hists.get("freshness.event_lag_ms") or {}
+    return {
+        "reason": doc.get("reason", ""),
+        "event_lag_p50_ms": gauges.get("freshness.event_lag_p50_ms"),
+        "event_lag_p95_ms": gauges.get("freshness.event_lag_p95_ms"),
+        "event_lag_p99_ms": gauges.get("freshness.event_lag_p99_ms"),
+        "publish_lag_p95_ms": gauges.get("freshness.publish_lag_p95_ms"),
+        "watermark_lag_ms": gauges.get("freshness.watermark_lag_ms"),
+        "event_lag_batches": int(lag_hist.get("count", 0)),
+        "critical_ticks": critical,
+        "critical": max(critical, key=critical.get) if critical else "",
+        "slo_breaches": int(counters.get("freshness.slo_breaches", 0)),
+        "slo_checkpoints": int(counters.get("freshness.slo_checkpoints", 0)),
+        "serving_stale_breaches": int(counters.get("serve.stale_breaches", 0)),
+        "snapshot_age_s": gauges.get("serving.snapshot_age_s"),
+        "ingest_event_lag_ms": gauges.get("ingest.event_time_lag_ms"),
+        "rss_slope_mb_per_min": gauges.get("host.rss_slope_mb_per_min"),
+        "breach_events": breaches[-tail_events:],
+    }
+
+
+def _ms(v) -> str:
+    return "—" if v is None else f"{float(v):.0f} ms"
+
+
+def render(summary: dict) -> str:
+    out = [f"freshness post-mortem — run ended: {summary['reason'] or '?'}"]
+    if summary["event_lag_p95_ms"] is None and not summary["critical_ticks"]:
+        out.append(
+            "  (no freshness telemetry in this bundle — the run predates the "
+            "plane or ran with --freshness off)"
+        )
+        return "\n".join(out)
+    out.append(
+        "  event-time lag (created_at → delivery): "
+        f"p50 {_ms(summary['event_lag_p50_ms'])}  "
+        f"p95 {_ms(summary['event_lag_p95_ms'])}  "
+        f"p99 {_ms(summary['event_lag_p99_ms'])}  "
+        f"over {summary['event_lag_batches']} batches"
+    )
+    out.append(
+        f"  low watermark lag: {_ms(summary['watermark_lag_ms'])}   "
+        f"publish lag p95: {_ms(summary['publish_lag_p95_ms'])}"
+    )
+    if summary["critical_ticks"]:
+        ticks = sorted(
+            summary["critical_ticks"].items(), key=lambda kv: -kv[1]
+        )
+        total = sum(v for _, v in ticks) or 1
+        out.append("  critical-path edges (batches dominated):")
+        for edge, n in ticks:
+            out.append(f"    {edge:<12} {n:>8}  ({100.0 * n / total:.0f}%)")
+    out.append(
+        f"  freshness SLO: {summary['slo_breaches']} breach episode(s), "
+        f"{summary['slo_checkpoints']} forced checkpoint(s)"
+    )
+    if summary["snapshot_age_s"] is not None:
+        out.append(
+            f"  serving: snapshot age {float(summary['snapshot_age_s']):.1f} s, "
+            f"{summary['serving_stale_breaches']} stale episode(s)"
+        )
+    if summary["ingest_event_lag_ms"] is not None:
+        out.append(
+            f"  ingest event-time lag (sampled): "
+            f"{_ms(summary['ingest_event_lag_ms'])}"
+        )
+    if summary["rss_slope_mb_per_min"] is not None:
+        out.append(
+            f"  host RSS slope: "
+            f"{float(summary['rss_slope_mb_per_min']):.2f} MB/min"
+        )
+    for e in summary["breach_events"]:
+        out.append(f"  breach event: {json.dumps(e, sort_keys=True)}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in args
+    args = [a for a in args if a != "--json"]
+    if len(args) != 1:
+        print(__doc__, file=sys.stderr)
+        return 2
+    try:
+        doc = load_bundle(args[0])
+    except (OSError, MalformedBundle) as exc:
+        print(f"freshness_report: malformed bundle: {exc}", file=sys.stderr)
+        return 2
+    summary = summarize(doc)
+    if as_json:
+        print(json.dumps(summary))
+    else:
+        print(render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

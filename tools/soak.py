@@ -48,18 +48,12 @@ sys.path.insert(0, REPO)
 
 def _slope_mb_per_min(samples: "list[tuple[float, float]]") -> float:
     """Least-squares RSS slope over (seconds, MB) samples — robust to the
-    sawtooth a GC'd process shows, unlike endpoint deltas."""
-    n = len(samples)
-    if n < 2:
-        return 0.0
-    xs = [t / 60.0 for t, _ in samples]
-    ys = [m for _, m in samples]
-    mx = sum(xs) / n
-    my = sum(ys) / n
-    denom = sum((x - mx) ** 2 for x in xs)
-    if denom == 0:
-        return 0.0
-    return sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / denom
+    sawtooth a GC'd process shows, unlike endpoint deltas. Shared with the
+    live ``host.rss_slope_mb_per_min`` gauge (utils/rss.py) so the soak
+    report and the dashboard agree on the math."""
+    from twtml_tpu.utils.rss import slope_mb_per_min
+
+    return slope_mb_per_min(samples)
 
 
 def main(argv=None) -> None:

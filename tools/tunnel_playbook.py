@@ -77,6 +77,10 @@ BACKLOG = (
      "r18 one-pass featurize: host-stage ratios are backend-free, but "
      "the tunnel window shows the end-to-end dilution under live "
      "upload (BENCHMARKS 'One-pass featurize')"),
+    ("freshness", ["tools/bench_freshness.py", "--budget", "300"], 1200,
+     "r19 freshness plane on the real tunnel: the <=3% overhead gate in "
+     "the regime where delivered-batch host costs bind (BENCHMARKS "
+     "'Freshness plane overhead')"),
     ("soak", ["tools/soak.py", "--minutes", "20",
               "--maxRssSlopeMbPerMin", "10"], 1800,
      "the axon RSS retention under the arena (r17): slope gate proves "

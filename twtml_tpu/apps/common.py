@@ -16,6 +16,8 @@ from ..models.linear import StreamingLinearRegressionWithSGD
 from ..streaming import faults as _faults
 from ..streaming.sources import ReplayFileSource, Source, SyntheticSource
 from ..telemetry import blackbox as _blackbox
+from ..telemetry import freshness as _freshness
+from ..telemetry import lineage as _lineage
 from ..telemetry import metrics as _metrics
 from ..telemetry import modelwatch as _modelwatch
 from ..telemetry import sideband as _sideband
@@ -706,6 +708,11 @@ class AppCheckpoint:
         quality = _modelwatch.snapshot_for_checkpoint()
         if quality is not None:
             meta["quality"] = quality
+        # freshness stamp (ISSUE 16): the event-lag/watermark picture at
+        # save time, so checkpoint history carries the staleness story
+        fresh = _freshness.snapshot_for_checkpoint()
+        if fresh is not None:
+            meta["freshness"] = fresh
         self._ckpt.save(totals["batches"], self._get_state(), meta)
         self._last = totals["batches"]
         # sticky flight-recorder context: a post-mortem bundle names the
@@ -1099,6 +1106,66 @@ class ModelWatchGuard:
                 "continues, the sentinel still owns rollback)",
                 verdict["alert_run"], verdict["drift_score"],
                 verdict["loss_trend"] * 100.0,
+                "forced a verified-checkpoint save"
+                if saved else "no checkpoint dir configured, evidence "
+                "recorded to the flight recorder only",
+            )
+
+
+class FreshnessGuard:
+    """``--freshness`` delivery adapter (ISSUE 16): pops the batch's lineage
+    record at fetch delivery (telemetry/freshness.py — pure host arithmetic
+    over stamps the seams already took; zero added host fetches, zero added
+    collectives like the sentinel/model-watch checks) and implements the
+    ``--freshnessSloMs`` early-warning hook in the ModelWatchGuard shape:
+    when the event→delivery lag stays over the SLO for a sustained run, the
+    plane emits the blackbox event + counter and this guard forces ONE
+    verified-checkpoint save per breach episode (warn-only — a stale-but-
+    healthy model keeps training; it just leaves a restorable snapshot
+    behind from BEFORE the backlog grew).
+
+    Wired OUTERMOST in ``attach_super_batcher`` so every delivery — even
+    ticks the sentinel skips or the multihost filter drops as globally
+    empty — advances the lineage FIFO; the FIFOs stay aligned with the
+    dispatch order exactly because nothing upstream can swallow a
+    delivery before this hook sees it."""
+
+    def __init__(self, conf, ckpt: "AppCheckpoint | None" = None,
+                 totals: "dict | None" = None, lead: bool = True):
+        self.enabled = getattr(conf, "freshness", "on") == "on"
+        self._ckpt = ckpt
+        self._totals = totals if totals is not None else {}
+        self._lead = lead
+        self._saved_episode = False
+        self._slo_saves = _metrics.get_registry().counter(
+            "freshness.slo_checkpoints"
+        )
+
+    def observe(self, out, at_boundary: bool = True) -> None:
+        if not self.enabled:
+            return
+        verdict = _freshness.record_delivery()
+        if verdict is None:
+            return
+        if not verdict["breach"]:
+            self._saved_episode = False
+            return
+        if (
+            verdict["in_episode"]
+            and not self._saved_episode
+            and at_boundary  # save_now reads weights — they must be current
+        ):
+            self._saved_episode = True
+            self._slo_saves.inc()
+            saved = self._ckpt.save_now(self._totals) if (
+                self._ckpt is not None
+            ) else False
+            log.warning(
+                "freshness guard: event lag %.0f ms over SLO for %d "
+                "batches (critical edge: %s) — %s (warn-only; training "
+                "continues, the sentinel still owns rollback)",
+                verdict["event_lag_ms"], verdict["breach_run"],
+                verdict["critical"] or "?",
                 "forced a verified-checkpoint save"
                 if saved else "no checkpoint dir configured, evidence "
                 "recorded to the flight recorder only",
@@ -1635,9 +1702,13 @@ class SuperBatcher:
         from ..features.batch import (
             pack_ragged_group, stack_batches, wire_nbytes,
         )
+        import time as _time
 
+        t0 = _time.perf_counter()
         if not self._coalesce(batches[0]):
-            return stack_batches(batches)
+            wire = stack_batches(batches)
+            _sideband.record_stage("wire_pack", _time.perf_counter() - t0)
+            return wire
         packer = self._group_packer or (
             lambda bs: pack_ragged_group(bs, codec=self.wire_codec or None)
         )
@@ -1651,6 +1722,7 @@ class SuperBatcher:
         else:
             wire = packer(batches)
         _record_wire_codec(wire, self._codec_requested())
+        _sideband.record_stage("wire_pack", _time.perf_counter() - t0)
         return wire
 
     def _codec_requested(self) -> str:
@@ -1678,6 +1750,8 @@ class SuperBatcher:
             for batch, t in group:
                 if self.max_dispatch and self._dispatched >= self.max_dispatch:
                     return
+                import time as _time
+
                 wire = batch
                 if self._coalesce(batch):
                     from ..features.batch import pack_batch
@@ -1687,19 +1761,22 @@ class SuperBatcher:
                             b, codec=self.wire_codec or None
                         )
                     )
+                    t0 = _time.perf_counter()
                     if tr.enabled:
                         with tr.span("wire_pack", mode="single"):
                             wire = packer(batch)
                     else:
                         wire = packer(batch)
+                    _sideband.record_stage(
+                        "wire_pack", _time.perf_counter() - t0
+                    )
                     _record_wire_codec(wire, self._codec_requested())
-                import time as _time
-
                 t0 = _time.perf_counter()
                 _faults.perturb("step")  # --chaos dispatch injection
                 out_dev = self.model.step(wire)
                 dt = _time.perf_counter() - t0
                 _sideband.record_stage("dispatch", dt)
+                _lineage.mark_dispatch()
                 if tr.enabled:
                     tr.complete("dispatch", t0, dt)
                 # dispatch-time accounting, as on the grouped path; if the
@@ -1745,6 +1822,7 @@ class SuperBatcher:
         outs = self.model.step_many(wire)
         dt = _time.perf_counter() - t0
         _sideband.record_stage("dispatch", dt)
+        _lineage.mark_dispatch(len(group))
         if tr.enabled:
             tr.complete("dispatch", t0, dt, group=len(group),
                         depth=len(self._inflight))
@@ -1965,12 +2043,15 @@ class FetchPipeline:
             if stop is not None and stop():
                 return  # the cap landed on an emitted batch: do not dispatch
         tr = _trace.get()
+        import time as _time
+
         if self.pack:
             from ..features.batch import pack_batch
 
             packer = self._packer or (
                 lambda b: pack_batch(b, codec=self.wire_codec or None)
             )
+            t0 = _time.perf_counter()
             if tr.enabled:
                 from ..features.batch import wire_nbytes
 
@@ -1979,6 +2060,7 @@ class FetchPipeline:
                     sp.add(wire_bytes=wire_nbytes(wire))
             else:
                 wire = packer(batch)
+            _sideband.record_stage("wire_pack", _time.perf_counter() - t0)
             _record_wire_codec(
                 wire,
                 (getattr(self.model, "wire_codec", "") or "")
@@ -1991,13 +2073,12 @@ class FetchPipeline:
         # unconditionally for the sideband's upload attribution, with the
         # --chaos injection INSIDE the window so injected dispatch stalls
         # attribute like real ones
-        import time as _time
-
         t0 = _time.perf_counter()
         _faults.perturb("step")  # --chaos dispatch injection
         out = self.model.step(wire)  # dispatch on the MAIN thread
         dt = _time.perf_counter() - t0
         _sideband.record_stage("dispatch", dt)
+        _lineage.mark_dispatch()
         if tr.enabled:
             tr.complete("dispatch", t0, dt, depth=len(self._pending))
         self._pending.append(
@@ -2254,7 +2335,7 @@ def elastic_exit(failed: bool = False) -> None:
 
 def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
                          max_dispatch: int = 0, abort=None, sentinel=None,
-                         modelwatch=None, elastic=None):
+                         modelwatch=None, elastic=None, freshness=None):
     """Wire the app's per-batch ``handle(out, batch, t, at_boundary)`` to the
     stream: plain step-then-handle by default, grouped through a
     SuperBatcher when ``--superBatch K`` applies. Returns
@@ -2385,6 +2466,7 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
         def cb(batch, t):
             if batch.num_valid == 0:
                 log.debug("batch: 0")
+                _lineage.drop_newest()  # the shed batch never dispatches
                 return
             fn(batch, t)
 
@@ -2410,6 +2492,17 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
                     pipeline_ref[0].refund_dispatch()
                 return
             inner_handle(out, batch, t, at_boundary=at_boundary)
+
+    if freshness is not None and freshness.enabled:
+        # freshness adapter (ISSUE 16), the OUTERMOST delivery wrapper:
+        # every delivered batch — including ones the sentinel skips or the
+        # multihost filter drops as globally empty — must pop its lineage
+        # record, or the dispatch-ordered FIFO desynchronizes
+        fresh_inner = handle
+
+        def handle(out, batch, t, at_boundary=True):  # noqa: F811
+            freshness.observe(out, at_boundary=at_boundary)
+            fresh_inner(out, batch, t, at_boundary=at_boundary)
 
     # cadence drains exist for checkpoint saves only: without a
     # checkpointDir each drain would stall the fetch pipelining for a
@@ -2487,11 +2580,15 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
                 packer = getattr(model, "pack_for_wire", None) or (
                     lambda b: pack_batch(b, codec=wire_codec or None)
                 )
+                tp = _time.perf_counter()
                 if tr.enabled:
                     with tr.span("wire_pack", mode="single"):
                         wire = packer(batch)
                 else:
                     wire = packer(batch)
+                _sideband.record_stage(
+                    "wire_pack", _time.perf_counter() - tp
+                )
                 _record_wire_codec(
                     wire,
                     (getattr(model, "wire_codec", "") or "")
@@ -2506,6 +2603,7 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
             out = model.step(wire)
             d_dt = _time.perf_counter() - td
             _sideband.record_stage("dispatch", d_dt)
+            _lineage.mark_dispatch()
             if tr.enabled:
                 tr.complete("dispatch", td, d_dt)
             fetch = getattr(model, "fetch_output", None) or jax.device_get

@@ -113,6 +113,16 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     # sustained alert forces a verified-checkpoint save (apps/common)
     modelwatch = ModelWatchGuard(conf, ckpt, totals, lead=lead)
 
+    # freshness plane (--freshness, default on): event-time watermarks +
+    # per-batch critical-path lineage stamped at seams the pipeline already
+    # crosses — zero added fetches/collectives; a sustained --freshnessSloMs
+    # breach forces one verified checkpoint per episode (apps/common)
+    from ..telemetry import freshness as _freshness
+    from .common import FreshnessGuard
+
+    _freshness.configure(conf)
+    freshness_guard = FreshnessGuard(conf, ckpt, totals, lead=lead)
+
     from ..utils.tracing import Tracer
 
     tracer = Tracer(conf.profileDir)
@@ -163,6 +173,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         sentinel=sentinel,
         modelwatch=modelwatch,
         elastic=elastic_plane,
+        freshness=freshness_guard,
     )
 
     warmup_compile(stream, model, super_batch=group_k)

@@ -102,6 +102,13 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
 
     modelwatch = ModelWatchGuard(conf, ckpt, totals, lead=lead)
 
+    # freshness plane — same lineage/watermark/SLO plane as the flagship app
+    from ..telemetry import freshness as _freshness
+    from .common import FreshnessGuard
+
+    _freshness.configure(conf)
+    freshness_guard = FreshnessGuard(conf, ckpt, totals, lead=lead)
+
     def handle(out, batch, _batch_time, at_boundary=True) -> None:
         b = int(out.count)
         totals["count"] += b
@@ -150,6 +157,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         sentinel=sentinel,
         modelwatch=modelwatch,
         elastic=elastic_plane,
+        freshness=freshness_guard,
     )
     warmup_compile(stream, model, super_batch=group_k)
     ssc.start(lockstep=lockstep)

@@ -265,6 +265,25 @@ class ConfArguments:
                 f"modelWatch must be 'on' or 'off', got {self.modelWatch!r}"
             )
         self.modelWatchWindow: int = int(conf.get("modelWatchWindow", "8"))
+        # freshness plane (r16): event-time watermarks, per-batch critical
+        # path, and staleness SLOs from lineage records on existing seams
+        self.freshness: str = conf.get("freshness", "on")
+        if self.freshness not in ("on", "off"):
+            raise ValueError(
+                f"freshness must be 'on' or 'off', got {self.freshness!r}"
+            )
+        self.freshnessSloMs: float = float(conf.get("freshnessSloMs", "0"))
+        if self.freshnessSloMs < 0:
+            raise ValueError(
+                f"freshnessSloMs must be >= 0, got {self.freshnessSloMs}"
+            )
+        self.servingStaleSloS: float = float(
+            conf.get("servingStaleSloS", "0")
+        )
+        if self.servingStaleSloS < 0:
+            raise ValueError(
+                f"servingStaleSloS must be >= 0, got {self.servingStaleSloS}"
+            )
 
         # Multi-host process group (the reference's one-flag cluster story,
         # ConfArguments.scala:95-98 --master spark://host:port): here a
@@ -477,6 +496,31 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                                                verified-checkpoint save per episode (warn-only;
                                                no rollback behavior change).
                                                Default: {self.modelWatchWindow}
+  --freshness <on|off>                         End-to-end freshness plane: per-batch lineage
+                                               records stamped at the existing pipeline seams
+                                               (source read → featurize → wire pack → dispatch
+                                               → fetch delivery → publish) derive event-time
+                                               watermarks (freshness.event_lag_ms p50/p95/p99
+                                               from tweet created_at_ms to delivery), a
+                                               per-batch critical-path edge, and a low
+                                               watermark that rides the lockstep sideband —
+                                               zero added host fetches, zero added
+                                               collectives (/api/freshness + dashboard
+                                               "freshness · e2e lag" tiles). 'off' is the
+                                               pre-plane program bit-exactly.
+                                               Default: {self.freshness}
+  --freshnessSloMs <float ms>                  Freshness SLO: when > 0 and the event→delivery
+                                               lag stays above this for a sustained run of
+                                               batches, emit a blackbox event + counter and
+                                               force ONE verified-checkpoint save per episode
+                                               (warn-only, sentinel untouched; the
+                                               --modelWatchWindow early-warning shape).
+                                               0 = no gate. Default: {self.freshnessSloMs}
+  --servingStaleSloS <float s>                 Serving staleness SLO: when > 0 and the served
+                                               snapshot's age (serving.snapshot_age_s)
+                                               exceeds this, emit a blackbox event + counter
+                                               once per breach episode (warn-only). 0 = no
+                                               gate. Default: {self.servingStaleSloS}
   --blockWire <auto|on|off>                    Zero-copy native ingest for --ingest block:
                                                'on' parses raw block bytes straight into the
                                                ragged wire's unit representation (one C pass,
@@ -772,6 +816,18 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                 self.printUsage(1)
         elif flag == "--modelWatchWindow":
             self.modelWatchWindow = int(take())
+        elif flag == "--freshness":
+            self.freshness = take()
+            if self.freshness not in ("on", "off"):
+                self.printUsage(1)
+        elif flag == "--freshnessSloMs":
+            self.freshnessSloMs = float(take())
+            if self.freshnessSloMs < 0:
+                self.printUsage(1)
+        elif flag == "--servingStaleSloS":
+            self.servingStaleSloS = float(take())
+            if self.servingStaleSloS < 0:
+                self.printUsage(1)
         elif flag == "--faultEvery":
             self.faultEvery = int(take())
         elif flag == "--chaos":

@@ -43,9 +43,10 @@ from concurrent.futures import Future
 import numpy as np
 
 from ..apps.common import FetchAbort, FetchPipeline
+from ..telemetry import blackbox as _blackbox
 from ..telemetry import metrics as _metrics
 from ..utils import get_logger
-from ..utils.clock import now_ms
+from ..utils.clock import now_ms, now_s
 from .engine import PredictEngine
 
 log = get_logger("serving.plane")
@@ -83,6 +84,7 @@ class ServingPlane:
         dtype=None,
         featurizer=None,
         engine: "PredictEngine | None" = None,
+        stale_slo_s: float = 0.0,
     ) -> None:
         from ..features.featurizer import Featurizer
 
@@ -115,6 +117,15 @@ class ServingPlane:
         self._swap_count = reg.counter("serve.hot_swaps")
         self._queue_gauge = reg.gauge("serve.queue_depth")
         self._step_gauge = reg.gauge("serve.snapshot_step")
+        # serving staleness (ISSUE 16): installed-at stamp through the
+        # TWTML_NOW_MS seam → serving.snapshot_age_s on /api/serving and a
+        # dispatch-time model-staleness figure in every predict response;
+        # --servingStaleSloS > 0 arms a warn-only breach episode
+        self._age_gauge = reg.gauge("serving.snapshot_age_s")
+        self._stale_breach_count = reg.counter("serve.stale_breaches")
+        self.stale_slo_s = max(0.0, float(stale_slo_s or 0.0))
+        self._installed_at_s = -1.0
+        self._in_stale_episode = False
         self._latency = reg.histogram("serve.latency_s")
         self._batch_fill = reg.histogram("serve.batch_rows")
         # per-tenant served-row totals (the dashboard's per-tenant query
@@ -159,6 +170,7 @@ class ServingPlane:
             dtype=jnp.dtype(getattr(conf, "dtype", "float32")),
             featurizer=featurizer,
             engine=engine,
+            stale_slo_s=float(getattr(conf, "servingStaleSloS", 0.0) or 0.0),
         )
 
     # -- request intake ------------------------------------------------------
@@ -263,6 +275,10 @@ class ServingPlane:
         self._engine.set_snapshot(snapshot)
         self._snapshot_level = snapshot.quality_level
         self._step_gauge.set(self._engine.snapshot_step)
+        # snapshot-age epoch: the swap moment through the pinnable clock
+        # seam (TW006), so replayed runs see replayed ages
+        self._installed_at_s = now_s()
+        self._age_gauge.set(0.0)
 
     def _apply_pending_swap(self) -> None:
         with self._cond:
@@ -377,7 +393,9 @@ class ServingPlane:
                 # the payload so the response names the weights that served
                 # it even if a swap lands before the fetch returns
                 self._pipe.on_batch(
-                    batch, (group, self._engine.snapshot_step)
+                    batch,
+                    (group, self._engine.snapshot_step,
+                     self._installed_at_s),
                 )
             except FetchAbort:
                 self._abort_requests()
@@ -393,7 +411,14 @@ class ServingPlane:
     def _deliver(self, host_out, batch, payload, at_boundary=True) -> None:
         """FetchPipeline handler: slice the batch's predictions back to the
         requests that rode it and resolve their futures."""
-        group, step = payload
+        group, step, *rest = payload
+        installed = rest[0] if rest else self._installed_at_s
+        # dispatch-time model staleness: how old the serving weights were
+        # when THIS batch dispatched — the per-response freshness figure
+        # (ISSUE 16); a swap landing mid-flight doesn't rewrite history
+        staleness = (
+            max(0.0, now_s() - installed) if installed >= 0.0 else -1.0
+        )
         preds = self._engine.predictions_for(host_out, batch)
         counts = self._engine.tenant_row_counts(batch)
         if counts is not None:
@@ -408,6 +433,7 @@ class ServingPlane:
             req.future.set_result({
                 "predictions": [float(v) for v in preds[offset:offset + n]],
                 "snapshot_step": int(step),
+                "model_staleness_s": round(staleness, 3),
             })
             offset += n
 
@@ -458,12 +484,35 @@ class ServingPlane:
                 {"tenant": m, "rows": int(r)}
                 for m, r in enumerate(self._tenant_rows)
             ]
+        age = (
+            max(0.0, now_s() - self._installed_at_s)
+            if self._installed_at_s >= 0.0 else -1.0
+        )
+        self._age_gauge.set(round(age, 1))
+        if self.stale_slo_s > 0.0 and age > self.stale_slo_s:
+            if not self._in_stale_episode:
+                # one blackbox event + counter per breach episode — the
+                # warn-only PR 8 shape (no serving behavior change)
+                self._in_stale_episode = True
+                self._stale_breach_count.inc()
+                _blackbox.record(
+                    "serving_stale_breach", age_s=round(age, 1),
+                    slo_s=self.stale_slo_s, step=int(self.snapshot_step),
+                )
+                log.warning(
+                    "serving snapshot is stale: age %.1f s > SLO %.1f s "
+                    "(step %d) — promotion/handoff may be wedged",
+                    age, self.stale_slo_s, self.snapshot_step,
+                )
+        else:
+            self._in_stale_episode = False
         view = {
             "qps": round(reqs / window, 2),
             "rowsPerSec": round(rows / window, 1),
             "p50Ms": round(self._latency.percentile(0.50) * 1e3, 2),
             "p95Ms": round(self._latency.percentile(0.95) * 1e3, 2),
             "p99Ms": round(self._latency.percentile(0.99) * 1e3, 2),
+            "snapshotAgeS": round(age, 1),
             "snapshotStep": int(self.snapshot_step),
             "level": self._snapshot_level,
             "requests": int(self._req_count.snapshot()),

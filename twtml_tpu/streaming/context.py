@@ -29,6 +29,7 @@ from typing import Callable
 
 from ..features.batch import FeatureBatch, UnitBatch
 from ..features.featurizer import Featurizer, Status
+from ..telemetry import lineage as _lineage
 from ..telemetry import metrics as _metrics
 from ..telemetry import sideband as _sideband
 from ..telemetry import trace as _trace
@@ -451,6 +452,10 @@ class FeatureStream(RawStream):
     def _process(
         self, statuses: list[Status], batch_time: float
     ) -> "FeatureBatch | UnitBatch":
+        # freshness lineage (r16): stamp the batch's record as it enters
+        # featurize — the event-time span + a stage-clock snapshot; no-op
+        # unless the plane is on
+        _lineage.open_batch(statuses)
         batch = self._featurize(statuses)
         self._check_buckets(batch)
         self._record_metrics(batch)
@@ -673,6 +678,10 @@ class StreamingContext:
             stream._process(statuses, batch_time)
             self.batches_processed += 1
             return
+        # freshness lineage (r16): one open per lockstep batch, stamped
+        # before featurize like FeatureStream._process (the failure paths
+        # below re-featurize but never re-open)
+        _lineage.open_batch(statuses)
         try:
             batch = stream._featurize(statuses)
         except Exception:

@@ -50,6 +50,22 @@ def _burst_extra() -> int:
     return _faults_mod.burst_extra()
 
 
+def _record_event_lag(created_at_ms: int) -> None:
+    """Ingest event-time lag gauge (ISSUE 16 satellite): arrival wall-clock
+    minus the tweet's own ``created_at_ms`` — the gap the paced replay
+    branch has computed (and dropped) since r1. Lazy metrics import keeps
+    the sources module import-light; the clock goes through the
+    ``TWTML_NOW_MS`` seam so tests pin it."""
+    if created_at_ms <= 0:
+        return
+    from ..telemetry import metrics as _metrics
+    from ..utils.clock import now_ms
+
+    _metrics.get_registry().gauge("ingest.event_time_lag_ms").set(
+        float(max(0, now_ms() - int(created_at_ms)))
+    )
+
+
 def _maybe_corrupt(data: bytes) -> bytes:
     global _faults_mod
     if _faults_mod is None:
@@ -213,6 +229,7 @@ class ReplayFileSource(Source):
             prev_ms: int | None = None
             tr = _trace.get()
             t_parse, n_parse = 0.0, 0
+            n_lag = 0
             with open(self.path, encoding="utf-8") as fh:
                 for line in fh:
                     line = line.strip()
@@ -242,8 +259,19 @@ class ReplayFileSource(Source):
                         if prev_ms and status.created_at_ms > prev_ms:
                             gap_ms = status.created_at_ms - prev_ms
                         prev_ms = status.created_at_ms or prev_ms
+                        # paced replays record per status: the pacing wait
+                        # dwarfs one clock read
+                        _record_event_lag(status.created_at_ms)
                         if self._stop.wait(gap_ms / 1000.0 / self.speed):
                             return
+                    else:
+                        # as-fast-as-possible replays sample every
+                        # PARSE_SPAN_EVERY statuses — per-tweet clock reads
+                        # would tax the ~1.2M tweets/s parser
+                        n_lag += 1
+                        if n_lag >= self.PARSE_SPAN_EVERY:
+                            n_lag = 0
+                            _record_event_lag(status.created_at_ms)
                     yield status
             if n_parse:
                 tr.complete(

@@ -152,6 +152,10 @@ class Serving:
     p50Ms: float = 0.0
     p95Ms: float = 0.0
     p99Ms: float = 0.0
+    # serving staleness (ISSUE 16): seconds since the active snapshot was
+    # installed (-1 before the first install); decode default keeps legacy
+    # frames valid
+    snapshotAgeS: float = -1.0
     snapshotStep: int = -1
     level: str = ""
     requests: int = 0
@@ -167,6 +171,35 @@ class Serving:
     refusedPromotions: int = 0
 
     json_class = "Serving"
+
+
+@dataclass
+class Freshness:
+    """End-to-end freshness view — an ADDITIVE message type (no reference
+    equivalent). Derived by telemetry/freshness.py from per-batch lineage
+    records stamped at the existing pipeline seams (zero added fetches,
+    zero added collectives — the PR 1/5/8 law): event-time lag percentiles
+    from tweet ``created_at_ms`` to fetch delivery and to stats publish,
+    the rolling low-watermark sparkline, the dominant critical-path edge
+    with its per-edge tick counts, and the ``--freshnessSloMs`` breach
+    state. Legacy dashboards ignore it like the other additive types."""
+
+    batches: int = 0
+    rows: int = 0
+    eventLagMs: float = -1.0
+    eventLagP50Ms: float = -1.0
+    eventLagP95Ms: float = -1.0
+    eventLagP99Ms: float = -1.0
+    publishLagP95Ms: float = -1.0
+    watermarkLagMs: float = -1.0
+    watermark: list = field(default_factory=list)
+    critical: str = ""
+    criticalTicks: dict = field(default_factory=dict)
+    sloMs: float = 0.0
+    breachRun: int = 0
+    breaches: int = 0
+
+    json_class = "Freshness"
 
 
 @dataclass
@@ -190,7 +223,8 @@ class Fleet:
 
 TYPES = {"Config": Config, "Stats": Stats, "Series": Series,
          "Metrics": Metrics, "Hosts": Hosts, "Tenants": Tenants,
-         "ModelHealth": ModelHealth, "Serving": Serving, "Fleet": Fleet}
+         "ModelHealth": ModelHealth, "Serving": Serving, "Fleet": Fleet,
+         "Freshness": Freshness}
 
 
 def encode(obj: Config | Stats) -> str:

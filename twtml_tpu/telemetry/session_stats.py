@@ -72,6 +72,12 @@ class SessionStats:
         # attempt is MADE, never raises into the ML loop)
         self._web_breaker = CircuitBreaker("web")
         self._lgn_breaker = CircuitBreaker("lightning")
+        # rolling (monotonic_s, rss_mb) samples, one per publish tick, for
+        # the continuous leak-rate gauge (ISSUE 16 satellite — the
+        # tools/soak.py least-squares slope, live instead of offline)
+        import collections
+
+        self._rss_samples: collections.deque = collections.deque(maxlen=256)
 
     def open(self) -> "SessionStats":
         log.info("Initializing plot on lightning server: %s", self.conf.lightning)
@@ -181,6 +187,13 @@ class SessionStats:
             except Exception:
                 self._lgn_breaker.record_failure()
                 log.debug("lightning append failed", exc_info=True)
+        # freshness plane (ISSUE 16): stamp the event→publish lag for every
+        # batch delivered since the last stats push — a host-clock read over
+        # already-collected lineage records, inside the timed stats_publish
+        # window (zero device traffic, no-op when --freshness off)
+        from . import freshness as _freshness
+
+        _freshness.record_publish()
         self._updates += 1
         if self._updates % METRICS_EVERY == 0:
             self.publish_metrics()
@@ -195,12 +208,21 @@ class SessionStats:
         # visible on every /api/metrics payload and post-mortem bundle —
         # statm reads, no device traffic
         try:
-            from ..utils.rss import rss_mb
+            from ..utils.rss import rss_mb, slope_mb_per_min
 
             reg = _metrics.get_registry()
-            reg.gauge("host.rss_mb").set(round(rss_mb(), 1))
+            cur_mb = rss_mb()
+            reg.gauge("host.rss_mb").set(round(cur_mb, 1))
             reg.gauge("host.uptime_s").set(
                 round(_time_mod.monotonic() - _PROCESS_START_S, 1)
+            )
+            # continuous leak-rate gauge (ISSUE 16 satellite): least-squares
+            # MB/min over the rolling publish-tick samples — the soak
+            # estimator, live, so the axon-client retention (BENCHMARKS r3
+            # soak) shows as a rate without a dedicated soak run
+            self._rss_samples.append((_time_mod.monotonic(), cur_mb))
+            reg.gauge("host.rss_slope_mb_per_min").set(
+                round(slope_mb_per_min(self._rss_samples), 3)
             )
         except Exception:
             pass
@@ -285,3 +307,16 @@ class SessionStats:
             except Exception:
                 self._web_breaker.record_failure()
                 log.debug("web.model_health failed", exc_info=True)
+        # end-to-end freshness view (telemetry/freshness.py — derived from
+        # lineage records stamped at seams the pipeline already crosses;
+        # None until a delivery has been observed or when --freshness off)
+        from . import freshness as _freshness
+
+        fview = _freshness.last_freshness()
+        if fview is not None and self._web_breaker.allow():
+            try:
+                self.web.freshness(fview)
+                self._web_breaker.record_success()
+            except Exception:
+                self._web_breaker.record_failure()
+                log.debug("web.freshness failed", exc_info=True)

@@ -59,6 +59,10 @@ FIELDS = (
     "rollbacks",        # divergence-sentinel rollbacks (model.rollbacks)
     "rows_shed",        # ingest.rows_shed counter
     "health_degraded",  # 0 healthy / 1 degraded
+    "wire_pack_ms",     # per-tick delta like the other stage columns (r16)
+    "event_lag_ms",     # freshness plane: last event→delivery lag on this
+                        # host — the fleet's low watermark rides the
+                        # EXISTING cadence allgather, never a new one (r16)
 )
 WIDTH = len(FIELDS)
 
@@ -70,6 +74,7 @@ STAGE_FIELDS = {
     "dispatch_ms": "dispatch",
     "fetch_ms": "fetch",
     "publish_ms": "stats_publish",
+    "wire_pack_ms": "wire_pack",
 }
 
 
@@ -146,6 +151,10 @@ class SidebandCollector:
         vec[FIELDS.index("health_degraded")] = (
             1.0 if health.phase == health.DEGRADED else 0.0
         )
+        # lazy import: freshness imports this module for the stage clock
+        from . import freshness as _freshness
+
+        vec[FIELDS.index("event_lag_ms")] = _freshness.last_event_lag_ms()
         self._prev_stages = cur
         # non-finite values must never ride the collective (they would
         # poison every peer's view)

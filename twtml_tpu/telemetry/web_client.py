@@ -12,8 +12,8 @@ from __future__ import annotations
 import urllib.request
 
 from .api_types import (
-    Config, Fleet, Hosts, Metrics, ModelHealth, Series, Serving, Stats,
-    Tenants, decode, encode,
+    Config, Fleet, Freshness, Hosts, Metrics, ModelHealth, Series, Serving,
+    Stats, Tenants, decode, encode,
 )
 
 DEFAULT_SERVER = "http://localhost:8888"  # WebClient.scala:13
@@ -122,6 +122,13 @@ class WebClient:
         dashboard's Serving tile row (additive message; serving/plane.py)."""
         known = Serving.__dataclass_fields__
         self._post(Serving(**{k: v for k, v in view.items() if k in known}))
+
+    def freshness(self, view: dict) -> None:
+        """Push the end-to-end freshness view (telemetry/freshness.py
+        ``last_freshness()``) for the dashboard's "freshness · e2e lag"
+        tile row (additive message)."""
+        known = Freshness.__dataclass_fields__
+        self._post(Freshness(**{k: v for k, v in view.items() if k in known}))
 
     def fleet(self, view: dict) -> None:
         """Push the read-fleet view (``FleetRouter.stats()``) for the

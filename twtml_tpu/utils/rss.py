@@ -41,6 +41,26 @@ def rss_mb() -> float:
         return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
 
 
+def slope_mb_per_min(samples) -> float:
+    """Least-squares slope of ``(t_seconds, rss_mb)`` samples in MB/min —
+    the tools/soak.py leak-rate estimator, shared so the live
+    ``host.rss_slope_mb_per_min`` gauge and the offline soak report agree
+    on the math. 0.0 until two samples exist or all timestamps coincide."""
+    pts = list(samples)
+    if len(pts) < 2:
+        return 0.0
+    xs = [t / 60.0 for t, _ in pts]
+    ys = [m for _, m in pts]
+    n = float(len(pts))
+    mx = sum(xs) / n
+    my = sum(ys) / n
+    var = sum((x - mx) ** 2 for x in xs)
+    if var == 0.0:
+        return 0.0
+    cov = sum((x - mx) * (y - my) for x, y in zip(xs, ys))
+    return cov / var
+
+
 class RssWatchdog:
     """``tick()`` once per batch; samples every ``sample_every`` ticks and
     warns when RSS has grown ``warn_growth_mb`` beyond the first sample

@@ -57,6 +57,15 @@
       String(counters["wire.arena_recycled"] || 0);
     document.getElementById("rssMb").textContent =
       String(gauges["host.rss_mb"] || 0);
+    // continuous leak-rate gauge (utils/rss.py least-squares slope over
+    // publish-tick samples — the soak estimator, live)
+    document.getElementById("rssSlope").textContent =
+      Number(gauges["host.rss_slope_mb_per_min"] || 0).toFixed(2);
+    // ingest event-time lag (streaming/sources.py sampled gauge, ms → s);
+    // "—" until a replay/live source records one
+    const ingestLag = gauges["ingest.event_time_lag_ms"];
+    document.getElementById("ingestLag").textContent =
+      ingestLag === undefined ? "—" : (Number(ingestLag) / 1000).toFixed(1);
     document.getElementById("fetchDepth").textContent =
       String(gauges["fetch.queue_depth"] || 0);
     // ingest/state robustness (bounded queue + divergence sentinel)
@@ -162,6 +171,12 @@
     document.getElementById("serveSnapshot").textContent = hasSnapshot
       ? "ckpt-" + json.snapshotStep
       : "—";
+    // serving staleness (ISSUE 16): seconds since the active snapshot was
+    // installed; the stale badge mirrors the plane's warn-only SLO episode
+    const age = Number(json.snapshotAgeS);
+    const ageEl = document.getElementById("serveAge");
+    ageEl.textContent = hasSnapshot && age >= 0 ? age.toFixed(0) : "—";
+    ageEl.classList.toggle("stale", json.level === "stale");
     const levelEl = document.getElementById("serveLevel");
     const level = json.level || "—";
     levelEl.textContent = level;
@@ -300,6 +315,59 @@
     drawLossSpark(json.mse || []);
   }
 
+  function drawFreshSpark(values) {
+    // rolling watermark-lag sparkline (Freshness.watermark window)
+    const canvas = document.getElementById("freshSpark");
+    const ctx = canvas.getContext("2d");
+    const w = (canvas.width = canvas.clientWidth || 800);
+    const h = (canvas.height = canvas.clientHeight || 60);
+    ctx.clearRect(0, 0, w, h);
+    if (!values.length) {
+      ctx.fillStyle = "rgba(128,128,128,0.6)";
+      ctx.font = "11px system-ui";
+      ctx.fillText("watermark sparkline — waiting for freshness telemetry…", 8, 14);
+      return;
+    }
+    let lo = Math.min(...values), hi = Math.max(...values);
+    if (hi === lo) { hi = lo + 1; }
+    ctx.beginPath();
+    ctx.strokeStyle = "rgb(21, 128, 61)";
+    ctx.lineWidth = 1.4;
+    values.forEach((v, i) => {
+      const x = (i / Math.max(values.length - 1, 1)) * (w - 10) + 5;
+      const y = h - 6 - ((v - lo) / (hi - lo)) * (h - 12);
+      i ? ctx.lineTo(x, y) : ctx.moveTo(x, y);
+    });
+    ctx.stroke();
+    ctx.fillStyle = "rgba(128,128,128,0.8)";
+    ctx.font = "10px system-ui";
+    ctx.fillText(
+      "watermark lag " + Math.round(values[values.length - 1]) + " ms", 6, 12
+    );
+  }
+
+  function onFreshness(json) {
+    // end-to-end freshness tiles (telemetry/freshness.py view): event-time
+    // lag percentiles, event→publish lag, the low-watermark lag + its
+    // sparkline, the dominant critical-path edge, and the SLO breach count
+    const live = Number(json.batches) > 0;
+    const ms = (v) => (live && Number(v) >= 0 ? Number(v).toFixed(0) : "—");
+    document.getElementById("freshP50").textContent = ms(json.eventLagP50Ms);
+    document.getElementById("freshP95").textContent = ms(json.eventLagP95Ms);
+    document.getElementById("freshP99").textContent = ms(json.eventLagP99Ms);
+    document.getElementById("freshPublish").textContent =
+      ms(json.publishLagP95Ms);
+    document.getElementById("freshWatermark").textContent =
+      ms(json.watermarkLagMs);
+    document.getElementById("freshCritical").textContent =
+      json.critical || "—";
+    const breaches = Number(json.breaches || 0);
+    const breachEl = document.getElementById("freshBreaches");
+    breachEl.textContent = String(breaches);
+    breachEl.classList.toggle("degraded", breaches > 0);
+    drawFreshSpark(json.watermark || []);
+  }
+
   function onMessage(json) {
     switch (json.jsonClass) {
       case "Config": onConfig(json); break;
@@ -310,6 +378,7 @@
       case "ModelHealth": onModelHealth(json); break;
       case "Serving": onServing(json); break;
       case "Fleet": onFleet(json); break;
+      case "Freshness": onFreshness(json); break;
       case "Series":
         // live frames buffer until the history backfill lands (ordering)
         if (!backfilled) pendingSeries.push(json);
@@ -342,6 +411,8 @@
     fetch("/api/serving").then((r) => r.json()).then(onServing).catch(() => {});
     // read-fleet backfill (empty replicas[] off a router process)
     fetch("/api/fleet").then((r) => r.json()).then(onFleet).catch(() => {});
+    // freshness-plane backfill (batches 0 until a training run publishes)
+    fetch("/api/freshness").then((r) => r.json()).then(onFreshness).catch(() => {});
     // backfill the chart from the server's rolling series window, then
     // apply any live frames that arrived while the fetch was in flight
     const flush = () => {

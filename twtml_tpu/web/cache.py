@@ -17,8 +17,8 @@ import collections
 import json as _json
 
 from ..telemetry.api_types import (
-    Config, Fleet, Hosts, Metrics, ModelHealth, Series, Serving, Stats,
-    Tenants, decode, encode,
+    Config, Fleet, Freshness, Hosts, Metrics, ModelHealth, Series, Serving,
+    Stats, Tenants, decode, encode,
 )
 from ..utils import get_logger
 
@@ -41,6 +41,7 @@ class ApiCache:
         self._model = ModelHealth()
         self._serving = Serving()
         self._fleet = Fleet()
+        self._freshness = Freshness()
         self._series: collections.deque[Series] = collections.deque(
             maxlen=SERIES_WINDOW
         )
@@ -74,6 +75,10 @@ class ApiCache:
     def fleet(self) -> str:
         """Latest read-fleet view (in-memory only, like Stats)."""
         return encode(self._fleet)
+
+    def freshness(self) -> str:
+        """Latest end-to-end freshness view (in-memory only, like Stats)."""
+        return encode(self._freshness)
 
     def series(self) -> str:
         """Recent Series messages as a JSON array (chart backfill for
@@ -109,6 +114,8 @@ class ApiCache:
             self._serving = data
         elif isinstance(data, Fleet):
             self._fleet = data
+        elif isinstance(data, Freshness):
+            self._freshness = data
         elif isinstance(data, Series):
             self._series.append(data)
         else:

@@ -110,6 +110,10 @@ class Server:
         return web.Response(text=self.cache.serving(),
                             content_type="application/json")
 
+    async def _get_freshness(self, request: web.Request) -> web.StreamResponse:
+        return web.Response(text=self.cache.freshness(),
+                            content_type="application/json")
+
     async def _get_fleet(self, request: web.Request) -> web.StreamResponse:
         # a router process answers LIVE (the view is plain host bookkeeping
         # under a lock); any other process serves the cached additive view
@@ -178,6 +182,10 @@ class Server:
                 "predictions": result["predictions"],
                 "snapshotStep": result["snapshot_step"],
                 "servedRows": len(result["predictions"]),
+                # dispatch-time snapshot age (ISSUE 16): how stale the
+                # weights that scored THIS response were; -1 from planes
+                # predating the freshness stamp (fleet replicas mid-roll)
+                "modelStalenessS": result.get("model_staleness_s", -1.0),
             }),
             content_type="application/json",
         )
@@ -275,6 +283,7 @@ class Server:
         app.router.add_get("/api/model", self._get_model)  # model health
         app.router.add_get("/api/serving", self._get_serving)  # serve plane
         app.router.add_get("/api/fleet", self._get_fleet)  # read fleet
+        app.router.add_get("/api/freshness", self._get_freshness)  # e2e lag
         app.router.add_post("/api/predict", self._post_predict)  # front door
         app.router.add_get("/", self._index)
         app.router.add_get("/{path:.+}", self._static)
