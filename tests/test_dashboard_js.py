@@ -275,6 +275,33 @@ def test_hosts_frame_builds_tiles_and_names_straggler():
     assert all("gating" not in t.class_set for t in tiles)
 
 
+def test_hosts_frame_elastic_tile_shows_epoch_hosts_and_lead():
+    """r20 lead election: the elastic tile names the CURRENT lead next to
+    the epoch + live-host count (it moves only at a won election), and a
+    non-elastic run (epoch -1 / leadUid -1) keeps the dashes."""
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="Hosts", hosts=[], straggler=-1, stage="", skewMs=0.0,
+        epoch=2, liveHosts=3, leadUid=1, departed=1, rejoined=0,
+    ))
+    assert h.el("elasticEpoch").text == "2 · 3 hosts · lead 1"
+    assert h.el("elasticChurn").text == "1 / 0"
+    # a post-election 1-host epoch: singular "host", the winner as lead
+    h.ws.server_message(frame(
+        jsonClass="Hosts", hosts=[], straggler=-1, stage="", skewMs=0.0,
+        epoch=1, liveHosts=1, leadUid=1, departed=1, rejoined=0,
+    ))
+    assert h.el("elasticEpoch").text == "1 · 1 host · lead 1"
+    # not elastic: epoch/leadUid -1 → dashes, no stray "lead" text
+    h.ws.server_message(frame(
+        jsonClass="Hosts", hosts=[], straggler=-1, stage="", skewMs=0.0,
+        epoch=-1, liveHosts=0, leadUid=-1, departed=0, rejoined=0,
+    ))
+    assert h.el("elasticEpoch").text == "—"
+    assert h.el("elasticChurn").text == "—"
+
+
 def test_tenants_frame_builds_tiles_and_highlights_gating():
     """r10 Tenants tiles (ISSUE 7): one tile per tenant from the model-
     plane view, the gating (busiest) tenant highlighted, active count

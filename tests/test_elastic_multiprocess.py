@@ -1,5 +1,6 @@
-"""Elastic lockstep membership over REAL two-process gloo groups (r16,
-ISSUE 13): the fleet that shrinks, rebalances, and rejoins.
+"""Elastic lockstep membership over REAL multi-process gloo groups (r16,
+ISSUE 13; lead election r20, ISSUE 17): the fleet that shrinks,
+rebalances, rejoins — and now survives its own coordinator.
 
 Acceptance (ISSUE 13):
 - ``--chaos peer.kill`` on host 1 → host 0 SHRINKS to a 1-host group
@@ -15,6 +16,18 @@ Acceptance (ISSUE 13):
   multi-host, ROADMAP item 3 REMAINING) trains stats-identically to the
   raw multi-host wire — the agreement rides the existing alignment
   allgather.
+
+Acceptance (ISSUE 17 — kill the LEAD, the last single point of failure):
+- ``--chaos peer.kill:uid=0`` kills the lead mid-run → the survivor
+  detects the orphaned beacon, WINS the election (deterministic successor
+  rule: lowest live uid of the committed view), re-binds the beacon,
+  promotes its shadow checkpoint lineage, and keeps training — with a
+  continuation BIT-equal to a clean run from its own verified archives;
+- the healthy-tick zero-new-collectives law holds at 8-host scale
+  (the allgather count IS the tick count with 8 members' columns riding
+  it), and an 8-host churn storm (follower kill + lead kill + pauses,
+  driven by tools/chaos_fleet.py) forms every epoch with fleet-wide
+  CRC-identical resyncs and counted losses.
 """
 
 from __future__ import annotations
@@ -249,6 +262,116 @@ def test_peer_kill_shrinks_and_survivor_bitmatches_clean_run(tmp_path):
     )
 
 
+def test_lead_kill_elects_successor_and_bitmatches_clean_run(tmp_path):
+    """THE election acceptance (ISSUE 17): the LEAD hard-dies at lockstep
+    tick 4 (``--chaos peer.kill:uid=0`` — one fleet-wide spec, the uid
+    selector picks the victim). The survivor's wedge report hits an
+    ORPHANED beacon (connection refused — a dead lead, not a paused one),
+    so it elects: sole candidate, rank 0, re-binds the beacon, promotes
+    its standby checkpoint lineage, restores its OWN verified step-3
+    archive, and finishes the run as the new lead. No abort, the dead
+    lead's departed rows counted, and the survivor's post-election
+    trajectory is BIT-EQUAL to a clean run from the promoted archive."""
+    import shutil
+    import threading
+
+    path, statuses = _write_replay(tmp_path, 200)
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    standby = ck / "standby-u1"  # uid 1's shadow-save lineage
+    keep = tmp_path / "archives"  # rotation-proof copies of every save
+    keep.mkdir()
+    stop_copier = threading.Event()
+
+    def copier():
+        seen = set()
+        while not stop_copier.is_set():
+            for f in standby.glob("ckpt-*.npz"):
+                if f.name not in seen:
+                    try:
+                        shutil.copy2(f, keep / f.name)
+                        seen.add(f.name)
+                    except OSError:
+                        pass  # racing the writer's rename; next pass wins
+            stop_copier.wait(0.05)
+
+    copier_thread = threading.Thread(target=copier, daemon=True)
+    copier_thread.start()
+
+    base = _free_port_range()
+    env = _elastic_env()
+    # the SAME command line on every host: the uid selector does the aiming
+    args = _elastic_args(path, ck, extra=[
+        "--checkpointEvery", "1", "--chaos", "peer.kill:uid=0:tick=4",
+    ])
+    lead = _spawn_app(0, 2, base, args, env)
+    surv = _spawn_app(1, 2, base, args, env)
+    try:
+        so, se = surv.communicate(timeout=420.0)
+        lo, le = lead.communicate(timeout=60.0)
+    finally:
+        stop_copier.set()
+        copier_thread.join(timeout=5)
+    assert lead.returncode == 77, f"lead did not chaos-exit:\n{le[-2000:]}"
+    assert surv.returncode == 0, f"survivor failed:\n{se[-4000:]}"
+
+    # the survivor ELECTED itself instead of aborting: orphaned beacon
+    # detected, bind won, authority promoted, epoch formed without uid 0
+    assert "the lead (uid 0) is gone; electing a successor" in se
+    assert "uid 1 WON the election (beacon :" in se
+    assert "checkpoint authority PROMOTED after lead election" in se
+    assert "elastic epoch 1 formed: 1 host(s) [1]" in se
+    assert "intake shard rebalanced: now serving residues [0, 1] of 2" in se
+    assert "rows_lost_estimate" in se  # the dead lead's share, never silent
+    # telemetry ownership stayed with launch-time process 0 (now dead):
+    # the survivor's proof lives in its logs and its promoted archives
+    assert _stat_lines(so) == []
+
+    # ---- bit-equality vs a clean run from the PROMOTED archive ---------
+    # The election restored uid 1's standby step-3 checkpoint (count=96);
+    # the survivor then trained host 1's rows 48.. in 16-row buckets.
+    import jax
+
+    from twtml_tpu.checkpoint import Checkpointer
+    from twtml_tpu.config import ConfArguments
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.parallel import ParallelSGDModel, make_mesh
+
+    resync = re.search(
+        r"elastic resync: state from the lead's verified checkpoint "
+        r"\(count=(\d+), batches=(\d+), state crc ([0-9a-f]+)\)", se,
+    )
+    assert resync is not None, "survivor never logged the resync"
+    assert int(resync.group(1)) == 96 and int(resync.group(2)) == 3
+
+    from twtml_tpu.apps.common import state_checksum
+
+    state3, meta3 = Checkpointer(str(keep)).restore(step=3)
+    # the state the new lead continued from is BIT-equal to its own
+    # verified step-3 shadow archive: the logged resync CRC is its checksum
+    assert resync.group(3) == state_checksum(state3)
+    conf = ConfArguments().parse(["--backend", "cpu"])
+    mesh = make_mesh(num_data=2, devices=jax.devices()[:2])
+    model = ParallelSGDModel.from_conf(conf, mesh).set_initial_weights(state3)
+    feat = Featurizer(now_ms=NOW_MS)
+    shard1 = statuses[1::2]
+    for lo_i in range(48, len(shard1), 16):
+        batch = feat.featurize_batch_ragged(
+            shard1[lo_i:lo_i + 16], row_bucket=16, unit_bucket=64,
+            row_multiple=2,
+        )
+        model.step(model.pack_for_wire(batch))
+    # post-promotion saves continued into the standby directory — it IS
+    # the fleet lineage now
+    final_state, meta = Checkpointer(str(standby)).restore()
+    assert meta["count"] == 148  # 96 global + host 1's remaining 52
+    np.testing.assert_array_equal(
+        np.asarray(final_state), np.asarray(model.latest_weights),
+        err_msg="elected lead's state is not bit-equal to the clean "
+                "run-from-promoted-checkpoint",
+    )
+
+
 def test_killed_host_rejoins_with_bitmatching_weights(tmp_path):
     """THE rejoin acceptance: after the shrink, the SAME command line
     restarted parks at the lead's beacon, is admitted at the next epoch
@@ -395,3 +518,77 @@ def test_tenant_fleet_two_process_matches_single_process(tmp_path):
     np.testing.assert_allclose(
         np.asarray(w_multi), np.asarray(w_single), rtol=1e-4, atol=1e-7,
     )
+
+
+@pytest.mark.slow
+def test_healthy_eight_host_fleet_adds_no_collectives_and_no_fetches():
+    """The zero-new-collectives law AT SCALE (ISSUE 17): an 8-process
+    lockstep fleet with the membership plane active — 8 hosts' membership
+    columns widen the one cadence allgather's payload, never its call
+    count, and the pooled stats fetch stays one device_get per batch."""
+    nprocs = 8
+    base = _free_port_range()
+    env = dict(os.environ, PYTHONPATH=REPO)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(i), str(nprocs), str(base), "unit",
+             "elastic_count"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        for i in range(nprocs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=420.0)
+            if p.returncode != 0:
+                pytest.fail(
+                    f"worker failed rc={p.returncode}:\n{stderr[-3000:]}"
+                )
+            outs.append(json.loads(stdout.strip().splitlines()[-1]))
+    finally:
+        for p in procs:
+            p.kill()
+    for o in outs:
+        assert o["terminated"] and not o["failed"]
+        assert o["batches"] >= 2  # 192 rows / 8 hosts = 24 each, bucket 16
+        assert o["allgathers"] == o["ticks"], o
+        assert o["device_gets"] == o["batches"] == o["fetch_count"], o
+        assert o["epoch"] == 0 and o["members"] == list(range(nprocs))
+        assert o["transitions"] == []
+
+
+@pytest.mark.slow
+def test_churn_storm_eight_hosts_survives_follower_and_lead_kills(tmp_path):
+    """THE churn acceptance (ISSUE 17): an 8-host virtual fleet under the
+    storm driver (tools/chaos_fleet.py) — a follower dies, the fleet
+    shrinks; the LEAD dies, uid 1 wins the election and re-forms; a pause
+    stalls a third host under the watchdog threshold (no transition). All
+    epochs form, every survivor's per-reform resync CRC matches fleet-wide
+    (bit-matching continuations), losses are counted, and no host aborts."""
+    from tools.chaos_fleet import run_storm
+
+    res = run_storm(
+        hosts=8, tweets=1024, workdir=str(tmp_path),
+        chaos=(
+            "peer.kill:uid=5:tick=2,peer.kill:uid=0:tick=6,"
+            "peer.pause:uid=3:ticks=1@4"
+        ),
+    )
+    assert res["ok"], res["failures"]
+    assert sorted(res["killed"]) == [0, 5]
+    # one election, won by the lowest live uid of the committed view
+    assert res["elections"] == 1
+    assert res["winners"] == [1]
+    # the fleet walked the full epoch ladder: the initial 8, then 7
+    # (uid 5 dead), then 7 without uid 0 but with the elected lead (uid 1)
+    assert [m for _e, m in res["epochs"]] == [
+        list(range(8)), [0, 1, 2, 3, 4, 6, 7], [1, 2, 3, 4, 6, 7],
+    ]
+    # every reform's resync CRC agreed across every member that logged it
+    assert res["crc_rounds"] and all(
+        len(set(crcs)) == 1 for crcs in res["crc_rounds"]
+    )
+    # the sub-threshold pause caused churn, not a transition
+    assert res["pauses"] >= 1 and len(res["epochs"]) == 3

@@ -26,6 +26,10 @@ class _StubRuntime:
         self.epoch = epoch
         self.members = list(members)
         self.beacon = None
+        self.lead_uid = 0
+
+    def set_lead(self, uid: int) -> None:
+        self.lead_uid = int(uid)
 
 
 def _plane(uid, transitions, members=(0, 1, 2), **kw):
@@ -146,6 +150,109 @@ def test_low_skew_never_proposes():
 
 
 # ---------------------------------------------------------------------------
+# lead election (r20, ISSUE 17): pure protocol — no sockets, no processes
+
+
+def test_election_candidates_successor_ordering():
+    # successor rule: lowest live uid in the committed view, lead excluded
+    assert ms.election_candidates([0, 1, 2, 3], 0) == [1, 2, 3]
+    # membership order doesn't matter; uid order decides the ranks
+    assert ms.election_candidates([4, 2, 7], 2) == [4, 7]
+    # simultaneous lead + successor death: rank 0 (uid 1) never answers,
+    # rank 1 (uid 2) wins the bind after its stagger — the ordering alone
+    # makes the outcome deterministic without any extra agreement
+    assert ms.election_candidates([0, 1, 2], 0) == [1, 2]
+    # lead already gone from the view (evicted earlier): nothing to exclude
+    assert ms.election_candidates([3, 5], 0) == [3, 5]
+
+
+def test_ex_lead_rejoin_is_demoted_to_follower():
+    # a restarted uid 0 joining a fleet led by an elected successor must
+    # come back as a follower: leadership is sticky to lead_uid, not to
+    # the uid-0 birthright
+    rt = _StubRuntime(0)
+    rt.lead_uid = 2
+    plane = ms.MembershipPlane(
+        rt, lambda clean: None, lambda plan, reason: None
+    )
+    assert plane.lead_uid == 2
+    assert not plane.lead
+
+
+def test_adopt_lead_handoff_updates_runtime_and_counts():
+    from twtml_tpu.telemetry import metrics as _metrics
+
+    plane = _plane(2, [])
+    snap = _metrics.get_registry().snapshot()
+    before = snap["counters"].get("elastic.lead_handoffs", 0)
+    plane._adopt_lead({"lead_uid": 1}, "wedge report")
+    assert plane.runtime.lead_uid == 1 and plane.lead_uid == 1
+    assert not plane.lead
+    plane._adopt_lead({"lead_uid": 2}, "admission plan")
+    assert plane.lead  # this host IS uid 2: adopted leadership
+    # missing / unchanged lead_uid is a no-op
+    plane._adopt_lead({}, "hello")
+    plane._adopt_lead({"lead_uid": 2}, "hello")
+    assert plane.lead_uid == 2
+    snap = _metrics.get_registry().snapshot()
+    assert snap["counters"].get("elastic.lead_handoffs", 0) - before == 2
+    assert snap["gauges"].get("elastic.lead_uid") == 2
+
+
+def test_ingest_reads_proposal_from_elected_lead_row():
+    """After a handoff to uid 1, every host reads the proposal columns
+    from the ELECTED lead's row — the full evict dance driven by a
+    non-zero lead, bit-for-bit like the uid-0 version above."""
+    transitions: list = []
+    planes = [
+        _plane(u, transitions, evict_ticks=1, evict_skew_ms=100.0)
+        for u in range(3)
+    ]
+    for p in planes:
+        p.runtime.set_lead(1)
+    _sideband.publish_hosts(
+        {"hosts": [], "straggler": 2, "stage": "upload", "skew_ms": 500.0}
+    )
+    rows = np.stack([p.pre_tick() for p in planes]).astype(np.int64)
+    # only the elected lead proposes; the ex-lead row stays quiet
+    assert int(rows[1, ms.FIELDS.index("prop_epoch")]) == 1
+    assert int(rows[0, ms.FIELDS.index("prop_epoch")]) == 0
+    assert uids_from_mask(int(rows[1, ms.FIELDS.index("prop_view")])) == [0, 1]
+    for p in planes:
+        assert p.ingest(rows) == ""
+    rows = np.stack([p.pre_tick() for p in planes]).astype(np.int64)
+    assert (rows[:, ms.FIELDS.index("ack")] == 1).all()
+    actions = [p.ingest(rows) for p in planes]
+    assert actions == ["reform", "reform", "parked"]
+
+
+def test_elected_lead_is_never_self_evicted():
+    transitions: list = []
+    plane = _plane(1, transitions, evict_ticks=1, evict_skew_ms=100.0)
+    plane.runtime.set_lead(1)
+    _sideband.publish_hosts(
+        {"hosts": [], "straggler": 1, "stage": "fetch", "skew_ms": 900.0}
+    )
+    cols = plane.pre_tick()
+    assert int(cols[ms.FIELDS.index("prop_epoch")]) == 0
+
+
+def test_beacon_port_handoff_arithmetic():
+    from twtml_tpu.parallel import elastic
+
+    # the beacon lives at base+1 for the LIFETIME of the fleet: a
+    # successor re-binds the exact port the dead lead owned (the bind is
+    # the election lock), while epoch coordinators advance at base+2+e
+    # and never collide with it
+    rt = object.__new__(elastic.ElasticRuntime)
+    rt.base_port = 9000
+    assert rt.beacon_port == 9000 + elastic.BEACON_OFFSET == 9001
+    assert rt.port_for(0) == 9002
+    assert rt.port_for(5) == 9007
+    assert all(rt.port_for(e) != rt.beacon_port for e in range(52))
+
+
+# ---------------------------------------------------------------------------
 # chaos grammar: peer.kill / peer.pause (streaming/faults.py)
 
 from twtml_tpu.streaming.faults import (  # noqa: E402
@@ -165,6 +272,57 @@ def test_peer_chaos_grammar_parses():
     # defaults: kill at tick 1; pause for the documented default ticks
     assert int(ChaosInjector("peer.kill")._rules["peer.kill"][0].value) == 1
     assert "tick" in repr(ChaosInjector("peer.kill:tick=2")._rules["peer.kill"][0])
+
+
+def test_peer_chaos_uid_selector_parses_and_filters():
+    # kill-the-lead from one fleet-wide spec: the uid selector names the
+    # host by its ORIGINAL process id, order-free with tick=
+    inj = ChaosInjector("peer.kill:uid=0:tick=4")
+    (rule,) = inj._rules["peer.kill"]
+    assert rule.kind == "kill" and int(rule.value) == 4 and rule.uid == 0
+    assert rule.on_host(0) and not rule.on_host(3)
+    assert "uid=0" in repr(rule)
+    inj = ChaosInjector("peer.pause:ticks=2:uid=5@3")
+    (rule,) = inj._rules["peer.pause"]
+    assert rule.kind == "pause" and int(rule.value) == 2 and rule.uid == 5
+    # no selector = every host (the pre-r20 behavior)
+    assert ChaosInjector("peer.kill")._rules["peer.kill"][0].on_host(7)
+
+
+def test_peer_kill_uid_selector_only_fires_on_target(monkeypatch):
+    import os as _os
+
+    deaths: list = []
+    inj = ChaosInjector("peer.kill:uid=1:tick=2")
+    monkeypatch.setattr(_os, "_exit", lambda c: deaths.append(c))
+    inj.peer_chaos(2, 0.0, uid=0)   # wrong host: survives
+    assert deaths == []
+    inj.peer_chaos(2, 0.0, uid=1)   # the named host dies
+    assert deaths == [PEER_KILL_EXIT_CODE]
+
+
+def test_peer_pause_uid_filter_keeps_rng_draws_fleet_identical():
+    """uid-selected pause rules must evaluate their RNG draw on EVERY
+    host (filtering happens after ``fires``) — otherwise a prob-mode rule
+    alongside a uid-selected one would desynchronize the seeded sequence
+    across the fleet."""
+    import twtml_tpu.streaming.faults as faults
+
+    def draws(uid):
+        inj = ChaosInjector(
+            "peer.pause:uid=3:ticks=1@p0.5,peer.pause:ticks=1@p0.5,seed=9"
+        )
+        fired_at = []
+        orig_sleep = faults.time.sleep
+        faults.time.sleep = lambda s: fired_at.append(s)
+        try:
+            for tick in range(1, 40):
+                inj.peer_chaos(tick, 0.0, uid=uid)
+        finally:
+            faults.time.sleep = orig_sleep
+        return inj._rng.random()  # final RNG state == identical sequence
+
+    assert draws(0) == draws(3) == draws(11)
 
 
 @pytest.mark.parametrize("bad", [

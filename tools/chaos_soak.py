@@ -22,13 +22,21 @@ and RECOVER (no sentinel abort, no fetch abort), all three rules fire,
 and row losses show up in counters (rows_lost / rows_dropped_parse /
 rows_shed) — never silently.
 
+r20 adds a FLEET phase (--fleetPhase, on by default): one lead-kill
+election storm through tools/chaos_fleet.py — ``--fleetHosts`` real
+lockstep worker processes, the launch lead hard-killed mid-run, the
+survivors expected to elect the deterministic successor, re-form, and
+finish clean with fleet-agreeing resync CRCs and counted losses. The
+storm's violated invariants fold into this soak's ``failures``.
+
 On ANY invariant failure the soak collects the crash flight recorder's
 post-mortem bundle (telemetry/blackbox.py — the apps install it per round)
 into ``--artifactDir`` and prints its path, so a CI chaos failure is
 diagnosable after the fact instead of being a dead stdout log.
 
 Usage: python tools/chaos_soak.py [--minutes M] [--tweets N] [--chaos SPEC]
-          [--sourceChaos SPEC] [--sourcePhase on|off] [--artifactDir DIR]
+          [--sourceChaos SPEC] [--sourcePhase on|off]
+          [--fleetPhase on|off] [--fleetHosts N] [--artifactDir DIR]
 Prints one JSON line at the end; exits non-zero on any violated invariant.
 """
 
@@ -67,6 +75,7 @@ def main(argv=None) -> None:
     args = list(sys.argv[1:] if argv is None else argv)
     minutes, n_tweets, chaos = 10.0, 16384, DEFAULT_CHAOS
     source_chaos, source_phase = DEFAULT_SOURCE_CHAOS, True
+    fleet_phase, fleet_hosts = True, 2
     artifact_dir = ""
     i = 0
     while i < len(args):
@@ -80,6 +89,10 @@ def main(argv=None) -> None:
             source_chaos = args[i + 1]; i += 2
         elif args[i] == "--sourcePhase":
             source_phase = args[i + 1] == "on"; i += 2
+        elif args[i] == "--fleetPhase":
+            fleet_phase = args[i + 1] == "on"; i += 2
+        elif args[i] == "--fleetHosts":
+            fleet_hosts = int(args[i + 1]); i += 2
         elif args[i] == "--artifactDir":
             artifact_dir = args[i + 1]; i += 2
         else:
@@ -180,6 +193,22 @@ def main(argv=None) -> None:
                     "garbage fired but ingest.rows_dropped_parse is 0"
                 )
 
+    # -- fleet phase (r20): lead-kill election storm, real processes -----
+    # one storm, not time-budgeted (~90 s at 2 hosts): the launch lead is
+    # hard-killed mid-run and the survivors must elect the deterministic
+    # successor, re-form, and finish clean — the whole membership
+    # contract is verified from the OUTSIDE by tools/chaos_fleet.py
+    # (exit codes, epoch ladder, one winner, fleet-agreeing resync CRCs,
+    # counted losses), so its failures fold straight into this soak's
+    fleet_res = None
+    if fleet_phase and not failures:
+        from tools.chaos_fleet import run_storm
+        fleet_res = run_storm(
+            hosts=fleet_hosts, tweets=128 * fleet_hosts,
+            workdir=os.path.join(tmp, "fleet"),
+        )
+        failures.extend(f"fleet: {f}" for f in fleet_res["failures"])
+
     reg = _metrics.get_registry().snapshot()
     counters = reg["counters"]
     aborts = counters.get("fetch.aborts", 0)
@@ -221,6 +250,10 @@ def main(argv=None) -> None:
         "tweets": tweets,
         "source_rounds": src_rounds,
         "source_chaos": source_chaos if source_phase else "",
+        "fleet_hosts": fleet_hosts if fleet_phase else 0,
+        "fleet_elections": fleet_res["elections"] if fleet_res else 0,
+        "fleet_epochs": [m for _e, m in fleet_res["epochs"]]
+        if fleet_res else [],
         "sentinel_rollbacks": src_rollbacks,
         "rows_lost": counters.get("model.rows_lost", 0),
         "rows_dropped_parse": counters.get("ingest.rows_dropped_parse", 0),

@@ -114,12 +114,16 @@ def _init_elastic(conf, coordinator: str, num_processes: int,
             "re-synchronize from the lead's LIVE state instead of a "
             "verified on-disk checkpoint (reduced rollback guarantee)"
         )
-    if process_id == 0:
+    if process_id == 0 and runtime.beacon is not None:
+        # cold start: uid 0 owns the beacon and leads the launch
         runtime.beacon.publish("forming", 0, launch_members)
         runtime.form(0, launch_members)
         import jax
 
         return jax.process_index() == 0
+    # uid 0 with beacon=None is a RESTARTED ex-lead: an elected successor
+    # owns the beacon port now, so it rejoins through the same follower
+    # hello/park path as everyone else — demotion is losing the bind
     client = runtime.beacon_client()
     deadline = _time.monotonic() + _elastic._init_timeout_s()
     hello = None
@@ -133,6 +137,9 @@ def _init_elastic(conf, coordinator: str, num_processes: int,
             f"--elastic on: the lead's membership beacon at "
             f"{host}:{runtime.beacon_port} never answered — is the lead up?"
         )
+    # the answering beacon names the CURRENT lead (post-election it is the
+    # winner's uid, not 0); a restarted ex-lead adopts its successor here
+    runtime.set_lead(int(hello.get("lead_uid", 0)))
     if hello["state"] == "forming":
         runtime.form(0, launch_members)
     else:
@@ -153,6 +160,9 @@ def _init_elastic(conf, coordinator: str, num_processes: int,
             if plan and process_id in plan.get("members", []) and (
                 plan["epoch"] > state.get("epoch", -1)
             ):
+                # the admission plan names the lead that committed it (it
+                # may have changed during the park window)
+                runtime.set_lead(int(plan.get("lead_uid", runtime.lead_uid)))
                 runtime.joined_late = True
                 runtime.form(plan["epoch"], plan["members"])
                 joined = True
@@ -495,19 +505,15 @@ def build_model(conf, model_cls=StreamingLinearRegressionWithSGD):
         from ..parallel.tenants import TenantStackModel
 
         if _jax.process_count() > 1:
-            # app-level tenant fleet (r16, PR 7 REMAINING b): the tenant
-            # stack behind per-host sharded intake on the 1D process-
-            # aligned data mesh — the global tenant wire assembles on the
-            # row axis like the stacked superbatch wire, ONE pooled fetch
-            # per tick, and the elastic membership plane rebuilds it
-            # across epochs like the single-model plane
-            if conf.effective_wire() == "ragged":
-                raise SystemExit(
-                    "--tenants on multi-host ships the stacked tenant "
-                    "wire (padded or unit); the ragged tenant split would "
-                    "need per-tenant cross-host bucket agreement — use "
-                    "--wire padded"
-                )
+            # app-level tenant fleet (r16, PR 7 REMAINING b; ragged wire
+            # lifted in r20): the tenant stack behind per-host sharded
+            # intake on the 1D process-aligned data mesh — the global
+            # tenant wire assembles on the row axis like the stacked
+            # superbatch wire, ONE pooled fetch per tick, and the elastic
+            # membership plane rebuilds it across epochs like the
+            # single-model plane. Ragged tenant parts agree one shared
+            # per-shard bucket fleet-wide (a single allgather-max per
+            # batch — MultiHostTenantModel._stack_ragged_parts).
             from ..parallel.tenants import MultiHostTenantModel
 
             mesh = build_mesh(
@@ -574,21 +580,14 @@ def build_model(conf, model_cls=StreamingLinearRegressionWithSGD):
         import jax
 
         if jax.process_count() > 1:
-            if codec == "dict" and int(getattr(conf, "superBatch", 1) or 1) > 1:
-                # the coalesced K-group wire would need the agreed codec
-                # bucket across all K batches before the first of them is
-                # known — the k=1 flat wire is the multi-host codec form
-                raise SystemExit(
-                    "--wireCodec dict on multi-host is k=1 only for now: "
-                    "drop --superBatch (the compressed bucket agreement "
-                    "rides the per-batch alignment allgather)"
-                )
             from ..parallel.distributed import MultiHostSGDModel
 
             # the app featurizes only THIS host's rows: its local batch
             # must divide this host's share of the data axis. The codec
-            # bucket (r16) is agreed on the SAME pack-time alignment
-            # allgather the raw bucket already pays — zero new collectives.
+            # bucket (r16, groups in r20) is agreed on the SAME alignment
+            # allgather the raw bucket already pays — zero new collectives;
+            # for --superBatch groups, prepare() records each batch's
+            # agreed bucket and the group pack combines them arithmetically.
             mh = MultiHostSGDModel(model, mesh, rebuilder=sgd_rebuilder)
             mh.wire_codec = codec if codec == "dict" else ""
             return mh, max(1, model.num_data // jax.process_count())
@@ -631,26 +630,53 @@ class AppCheckpoint:
     ``get_state()`` returns the checkpointable arrays (flat dict or one
     array); ``set_state(state)`` restores them into the model.
 
-    Multi-host: only the lead (``lead=True``) WRITES (concurrent writers
-    against one directory would race), and restore is LEAD-AUTHORITATIVE —
-    after the local restore attempt, the lead's state/counters are
-    broadcast to every process, so a follower without the lead's filesystem
-    (no shared storage) still resumes consistently instead of silently
-    training from zeros against resumed peers."""
+    Multi-host: only the lead (``lead=True``) WRITES the fleet directory
+    (concurrent writers against one directory would race), and restore is
+    LEAD-AUTHORITATIVE — after the local restore attempt, the lead's
+    state/counters are broadcast to every process, so a follower without
+    the lead's filesystem (no shared storage) still resumes consistently
+    instead of silently training from zeros against resumed peers.
+
+    Elastic fleets (r20): every NON-lead host shadow-saves the same
+    verified archives into its own ``standby-u<uid>/`` subdirectory on the
+    same cadence — training is psum-identical, so the archives are
+    bit-identical to the lead's. That is the any-host-can-restore
+    discipline lead election relies on: ``promote()`` flips authority
+    after a won election and the new lead resyncs the fleet from its OWN
+    verified archives (no shared storage assumed). Broadcast sourcing
+    follows ``_lead`` (not hardcoded process 0), so authority tracks the
+    elected lead whatever its epoch pid is."""
 
     def __init__(self, conf, get_state, set_state, totals: dict,
                  lead: bool = True):
         self._ckpt = None
         self._get_state = get_state
         self._set_state = set_state
-        self._lead = lead
+        from ..parallel.elastic import get_runtime as _get_elastic_runtime
+
+        runtime = _get_elastic_runtime()
+        self._elastic = runtime is not None
+        self._lead = runtime.is_lead if self._elastic else lead
+        self._shadow = self._elastic and not self._lead
         self.every = int(getattr(conf, "checkpointEvery", 0) or 0)
         if not conf.checkpointDir:
             self._last = 0
             return
         from ..checkpoint import Checkpointer
 
-        self._ckpt = Checkpointer(conf.checkpointDir)
+        ckpt_dir = conf.checkpointDir
+        if self._shadow:
+            import os as _os
+
+            ckpt_dir = _os.path.join(
+                conf.checkpointDir, f"standby-u{runtime.uid}"
+            )
+            log.info(
+                "elastic standby checkpoints: this host shadow-saves "
+                "verified archives into %s (any-host-can-restore)",
+                ckpt_dir,
+            )
+        self._ckpt = Checkpointer(ckpt_dir)
         restored = self._ckpt.restore()
         if restored is not None:
             state, meta = restored
@@ -668,15 +694,17 @@ class AppCheckpoint:
             from jax.experimental import multihost_utils
 
             # every process contributes its own (structurally identical)
-            # state; all receive the lead's — process 0 is the writer, so
-            # its view of the checkpoint is the truth
+            # state; all receive the LEAD's — the lead is the fleet-dir
+            # writer, so its view of the checkpoint is the truth. Source
+            # by _lead, not process 0: after an election the lead's epoch
+            # pid is whatever the member sort gives it.
             meta_arr, state = multihost_utils.broadcast_one_to_all((
                 np.array(
                     [int(restored is not None),
                      totals["count"], totals["batches"]], np.int64,
                 ),
                 get_state(),
-            ))
+            ), is_source=bool(self._lead))
             # unconditional: a follower restoring a STALE local checkpoint
             # while the lead starts fresh must also converge on the lead
             set_state(jax.tree_util.tree_map(np.asarray, state))
@@ -698,7 +726,7 @@ class AppCheckpoint:
         self._last = totals["batches"]
 
     def _save(self, totals: dict) -> None:
-        if not self._lead:
+        if not self._lead and not self._shadow:
             self._last = totals["batches"]  # keep cadence bookkeeping aligned
             return
         meta = {"count": totals["count"], "batches": totals["batches"]}
@@ -741,6 +769,29 @@ class AppCheckpoint:
             return False
         self._save(totals)
         return True
+
+    def promote(self) -> None:
+        """Elastic lead handoff: this host won an election. Its standby
+        archives become the fleet's checkpoint lineage — future saves
+        continue into the same (formerly standby) directory, and the next
+        ``resync_from_verified`` restores from them and broadcasts with
+        this host as the source. Idempotent."""
+        if self._lead:
+            return
+        self._lead = True
+        self._shadow = False
+        if self._ckpt is not None:
+            log.warning(
+                "checkpoint authority PROMOTED after lead election: this "
+                "host's verified archives in %s are the fleet lineage now",
+                self._ckpt.directory,
+            )
+        from ..telemetry import blackbox as _blackbox
+
+        _blackbox.record(
+            "checkpoint_promoted",
+            directory=getattr(self._ckpt, "directory", ""),
+        )
 
     def resync_from_verified(self, totals: dict) -> bool:
         """Elastic epoch re-synchronization (r16): every member of a
@@ -801,7 +852,7 @@ class AppCheckpoint:
             batches = restored[1].get("batches", 0)
         meta_arr, state = multihost_utils.broadcast_one_to_all((
             np.array([1, count, batches], np.int64), state,
-        ))
+        ), is_source=bool(self._lead))
         adopt(
             jax.tree_util.tree_map(np.asarray, state),
             int(meta_arr[1]), int(meta_arr[2]),
@@ -849,7 +900,7 @@ class AppCheckpoint:
             state = restored[0]
         flag, state = multihost_utils.broadcast_one_to_all((
             np.array([ok], np.int64), state,
-        ))
+        ), is_source=bool(self._lead))
         if not int(flag[0]):
             return None
         self._set_state(jax.tree_util.tree_map(np.asarray, state))
@@ -1683,6 +1734,37 @@ class SuperBatcher:
         undispatched batches are host-side and survive untouched)."""
         self._drain()
 
+    def drain_discard(self, why: str) -> int:
+        """Rescue-path drain (elastic detach, ``clean=False``): a peer
+        died mid-step, so in-flight groups' collectives are POISONED —
+        see FetchPipeline.drain_discard. Discards every in-flight group
+        (cap slots refunded, leases discarded, rows counted in
+        ``elastic.rows_discarded_inflight``); buffered UNDISPATCHED
+        batches stay — they are host-side, never touched a collective,
+        and train correctly against the rolled-back state after the
+        reform. Returns the discarded row count."""
+        if not self._inflight:
+            return 0
+        groups, rows = len(self._inflight), 0
+        for future, group, _outs, lease in self._inflight:
+            future.cancel()  # not-yet-started fetches never run
+            for batch, _t in group:
+                rows += int(getattr(batch, "num_valid", 0) or 0)
+                self.refund_dispatch()
+            if lease is not None:
+                lease.discard()  # the dead-peer dispatch may still run
+        self._inflight.clear()
+        self._depth_gauge.set(0)
+        self._registry.counter("elastic.rows_discarded_inflight").inc(rows)
+        log.warning(
+            "elastic rescue: discarded %d in-flight group(s) (~%d row(s))"
+            " — %s; the resync restores the verified checkpoint, so these"
+            " rolled-back rows are counted in "
+            "elastic.rows_discarded_inflight, never awaited", groups, rows,
+            why,
+        )
+        return rows
+
     def _coalesce(self, batch) -> bool:
         """Whether this batch rides the coalesced one-buffer wire (group
         mode, ragged wire, and a model whose jit program unpacks it)."""
@@ -2108,6 +2190,41 @@ class FetchPipeline:
         (nothing may stay in flight across a backend rebuild)."""
         self._drain()
 
+    def drain_discard(self, why: str) -> int:
+        """Rescue-path drain (elastic detach, ``clean=False``): a peer
+        died mid-step, so any in-flight output's collectives are POISONED
+        — their buffer definition events fail permanently
+        (FAILED_PRECONDITION "Gloo all-reduce failed"), and awaiting them
+        just burns the fetch watchdog's re-issues before it aborts the
+        whole run (measured on the 2-host lead-kill storm,
+        tools/chaos_fleet.py). The reform restores the lead's verified
+        checkpoint anyway, so the rescue DISCARDS in-flight outputs
+        instead of awaiting them: cap slots refunded (every dispatched
+        batch is either delivered or refunded), arena leases discarded
+        (the dead-peer dispatch may still touch its wire buffer — never
+        reuse), and the rolled-back rows counted loudly in
+        ``elastic.rows_discarded_inflight``. Clean commits keep the
+        lossless ``drain()``. Returns the discarded row count."""
+        if not self._pending:
+            return 0
+        n, rows = len(self._pending), 0
+        for future, _out, batch, _t, lease in self._pending:
+            future.cancel()  # not-yet-started fetches never run
+            rows += int(getattr(batch, "num_valid", 0) or 0)
+            self.refund_dispatch()
+            if lease is not None:
+                lease.discard()
+        self._pending.clear()
+        self._depth_gauge.set(0)
+        self._registry.counter("elastic.rows_discarded_inflight").inc(rows)
+        log.warning(
+            "elastic rescue: discarded %d in-flight batch output(s) "
+            "(~%d row(s)) — %s; the resync restores the verified "
+            "checkpoint, so these rolled-back rows are counted in "
+            "elastic.rows_discarded_inflight, never awaited", n, rows, why,
+        )
+        return rows
+
     @property
     def pending_fetches(self) -> int:
         """In-flight pooled fetches (the serving plane's idle loop reads
@@ -2221,8 +2338,10 @@ def attach_elastic(conf, ssc, model, stream, ckpt, totals):
     app stack so a membership change is a full re-provisioning:
 
     detach — drain the fetch pipeline (nothing in flight across a backend
-    rebuild), on a CLEAN commit checkpoint at the boundary (loss-free),
-    then abandon the epoch's process group;
+    rebuild; a RESCUE discards in-flight outputs instead — a dead peer
+    poisons their collectives, ``drain_discard``), on a CLEAN commit
+    checkpoint at the boundary (loss-free), then abandon the epoch's
+    process group;
 
     attach — form the new epoch, rebuild the mesh + model in place,
     re-synchronize state/counters from the lead (broadcast of its verified
@@ -2267,7 +2386,14 @@ def attach_elastic(conf, ssc, model, stream, ckpt, totals):
         st["old_members"] = list(runtime.members)
         pipe = st.get("pipeline")
         if pipe is not None:
-            pipe.drain()
+            if clean:
+                pipe.drain()
+            else:
+                # a rescue: the dead peer poisoned any in-flight step's
+                # collectives — discard them (rows counted, resync rolls
+                # them back) instead of awaiting permanently-failed
+                # buffers into a watchdog abort
+                pipe.drain_discard("a peer died mid-step")
         if clean:
             # every member is alive and synchronized at a clean commit
             # tick: the lead snapshots HERE so the resync after formation
@@ -2277,6 +2403,12 @@ def attach_elastic(conf, ssc, model, stream, ckpt, totals):
 
     def attach(plan: dict, reason: str) -> None:
         runtime.form(plan["epoch"], plan["members"])
+        if runtime.is_lead:
+            # a won election lands here: checkpoint authority moves to
+            # this host BEFORE the resync broadcast below, so the fleet
+            # restores from the WINNER's verified archives (idempotent —
+            # an incumbent lead is already promoted)
+            ckpt.promote()
         mesh = build_mesh(conf, what=f"elastic epoch {plan['epoch']}")
         model.rebuild(mesh)
         if reason == "rejoin":

@@ -196,10 +196,13 @@ def _attach(pb, lease):
 
 def try_assemble_group(
     batches, s: int, bl: int, n_sb: int, narrow: bool,
-    codec: "str | None", num_shards_out: int,
+    codec: "str | None", codec_bucket: "int | None",
+    num_shards_out: int,
 ):
     """Fused twin of ``pack_ragged_group``'s body (validation already done
-    by the caller). None → numpy pipeline."""
+    by the caller). None → numpy pipeline. ``codec_bucket`` forces the
+    cross-host agreed group bucket (multi-host codec groups), mirroring
+    ``try_assemble_sharded``."""
     if not available():
         return None
     first = batches[0]
@@ -212,7 +215,7 @@ def try_assemble_group(
         if fa is None:
             return None
         fields.append(fa)
-    got = _run(fields, s, bl, n_sb, narrow, lut, 0)
+    got = _run(fields, s, bl, n_sb, narrow, lut, int(codec_bucket or 0))
     if got is None:
         return None
     buffer, enc_bucket, lease = got

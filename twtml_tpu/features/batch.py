@@ -778,6 +778,7 @@ def pack_ragged_group(
     batches, num_shards_out: int = 0,
     narrow_offsets: "bool | None" = None,
     codec: "str | None" = None,
+    codec_bucket: "int | None" = None,
 ) -> PackedBatch:
     """K same-signature ragged batches → ONE contiguous uint8 wire buffer
     (the coalesced superbatch wire, Lean wire v2).
@@ -807,7 +808,9 @@ def pack_ragged_group(
     ``num_shards_out`` mirrors ``pack_ragged_sharded`` (multi-host callers
     pack local shards, the layout carries the global count); ``codec``
     mirrors it too (per-segment digram compression, shared bucket,
-    all-or-nothing raw fallback — see ``_encode_units_segments``)."""
+    all-or-nothing raw fallback — see ``_encode_units_segments``), as does
+    ``codec_bucket`` (the cross-host AGREED group bucket: every process
+    must emit identical codec segment shapes for the global wire)."""
     if not batches:
         raise ValueError("cannot pack an empty group")
     first = batches[0]
@@ -839,7 +842,7 @@ def pack_ragged_group(
     from .assemble import try_assemble_group
 
     fast = try_assemble_group(
-        batches, s, bl, n_sb, narrow, codec, num_shards_out
+        batches, s, bl, n_sb, narrow, codec, codec_bucket, num_shards_out
     )
     if fast is not None:
         return fast
@@ -866,7 +869,7 @@ def pack_ragged_group(
     # compressed units wire (``--wireCodec dict``): every (shard, k)
     # segment's sub-buffer encodes independently into one shared bucket —
     # each device slice / scan step decodes exactly its own segments
-    codes = _encode_units_segments(fields[0], s * k, codec)
+    codes = _encode_units_segments(fields[0], s * k, codec, bucket=codec_bucket)
     if codes is not None:
         fields[0] = np.ascontiguousarray(
             codes.reshape(s, k, codes.shape[1])

@@ -227,6 +227,13 @@ class MultiHostSGDModel:
         # mesh-sharded model for a re-formed epoch's mesh — a closure over
         # the conf, set by apps/common.build_model
         self._rebuilder = rebuilder
+        # codec groups (r20): per-batch agreed codec buckets recorded at
+        # prepare() time (the one allgather), consumed by
+        # pack_group_for_wire. Keyed by id(batch) WITH the batch held, so
+        # ids cannot be recycled while an entry is live; entries for
+        # batches that never reach a group pack (shutdown flush) are the
+        # only residue.
+        self._group_buckets = {}
 
     def rebuild(self, mesh) -> "MultiHostSGDModel":
         """Swap in a fresh inner model on a NEW epoch's mesh IN PLACE —
@@ -260,12 +267,13 @@ class MultiHostSGDModel:
 
     # the ragged wire packs per shard on multi-host too (pack_for_wire);
     # the app-side pack opt-in keys off this (apps/common.py).
-    # --wireCodec dict (r16, ROADMAP item 3 REMAINING): the cross-host
-    # compressed bucket rides the SAME pack-time alignment allgather the
-    # raw bucket already pays (_ragged_local_aligned_codec) — zero added
-    # collectives, asserted by the counted elastic acceptance test; set by
-    # apps/common.build_model, k=1 flat wire only (the coalesced group
-    # wire still rejects the codec on multi-host).
+    # --wireCodec dict (r16, widened to groups in r20): the cross-host
+    # compressed bucket rides the SAME alignment allgather the raw bucket
+    # already pays (_ragged_local_aligned_codec) — zero added collectives,
+    # asserted by the counted elastic acceptance test; set by
+    # apps/common.build_model. Groups (--superBatch > 1): prepare()
+    # records each batch's agreed bucket, pack_group_for_wire combines
+    # them (raw-dominates, else max) with plain arithmetic.
     accepts_packed = True
     wire_codec = ""
 
@@ -293,8 +301,21 @@ class MultiHostSGDModel:
         cross-process agreed bucket — so every host's group signatures,
         closure ticks, and stacked shapes are identical (the lockstep
         contract extended to groups). Runs at the scheduler tick, a
-        deterministic point, so the agree collective always pairs."""
+        deterministic point, so the agree collective always pairs.
+
+        With ``wire_codec`` set (r20, codec groups), the SAME alignment
+        allgather also agrees this batch's codec bucket — recorded here
+        and consumed by ``pack_group_for_wire``, which combines the K
+        batches' agreed buckets into the group bucket with ZERO additional
+        collectives (the agreed values are fleet-identical, so the
+        combine is plain arithmetic on every host)."""
         if isinstance(batch, RaggedUnitBatch):
+            if self.wire_codec:
+                aligned, bucket = _ragged_local_aligned_codec(
+                    batch, self.mesh, codec=self.wire_codec
+                )
+                self._group_buckets[id(aligned)] = (aligned, bucket)
+                return aligned
             return _ragged_local_aligned(batch, self.mesh)
         if isinstance(batch, UnitBatch) and batch.units.dtype != np.uint16:
             return batch._replace(units=batch.units.astype(np.uint16))
@@ -319,9 +340,17 @@ class MultiHostSGDModel:
                 "assemble as plain arrays"
             )
         if self.wire_codec:
-            aligned, codec_bucket = _ragged_local_aligned_codec(
-                local_batch, self.mesh, codec=self.wire_codec
-            )
+            got = self._group_buckets.pop(id(local_batch), None)
+            if got is not None:
+                # already prepared (a partial superbatch tail riding the
+                # k=1 wire): alignment AND bucket were agreed at prepare()
+                # time — no second collective, and the recorded bucket is
+                # fleet-identical by construction
+                aligned, codec_bucket = got
+            else:
+                aligned, codec_bucket = _ragged_local_aligned_codec(
+                    local_batch, self.mesh, codec=self.wire_codec
+                )
             pb = pack_ragged_sharded(
                 aligned, num_shards_out=self.num_data,
                 codec=self.wire_codec if codec_bucket else None,
@@ -348,13 +377,39 @@ class MultiHostSGDModel:
         exactly the ``pack_for_wire`` assembly, K segments deep. The
         per-process block is this host's local shards' [K, per-segment]
         bytes, so the shard-major global layout is contiguous per process
-        and the data axis shards it like the single-group wire."""
+        and the data axis shards it like the single-group wire.
+
+        With ``wire_codec`` set (r20), each batch's cross-host agreed
+        bucket was recorded at ``prepare`` time; the group bucket is raw
+        if ANY batch agreed raw, else the max agreed bucket (covers every
+        batch's segments, and is computed from fleet-identical agreed
+        values — zero collectives at pack time)."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from ..features.batch import PackedBatch, pack_ragged_group
 
-        aligned = [_ragged_local_aligned(b, self.mesh) for b in batches]
-        pb = pack_ragged_group(aligned, num_shards_out=self.num_data)
+        if self.wire_codec:
+            aligned, buckets = [], []
+            for b in batches:
+                got = self._group_buckets.pop(id(b), None)
+                if got is None:
+                    # not prepared through the codec agreement (a direct
+                    # caller outside the SuperBatcher) — align raw, which
+                    # forces the whole group raw on every host identically
+                    aligned.append(_ragged_local_aligned(b, self.mesh))
+                    buckets.append(0)
+                else:
+                    aligned.append(b)
+                    buckets.append(got[1])
+            group_bucket = 0 if 0 in buckets else max(buckets)
+            pb = pack_ragged_group(
+                aligned, num_shards_out=self.num_data,
+                codec=self.wire_codec if group_bucket else None,
+                codec_bucket=group_bucket or None,
+            )
+        else:
+            aligned = [_ragged_local_aligned(b, self.mesh) for b in batches]
+            pb = pack_ragged_group(aligned, num_shards_out=self.num_data)
         sharding = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
         buf = jax.make_array_from_process_local_data(
             sharding, pb.buffer,

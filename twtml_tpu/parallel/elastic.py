@@ -13,7 +13,7 @@ mode these deviations dodge).
 
 This module makes the group a sequence of EPOCHS instead. Per epoch it owns
 the jaxlib distributed client/service directly (not
-``jax.distributed.initialize``) with three deliberate deviations, each
+``jax.distributed.initialize``) with four deliberate deviations, each
 forced by a measured failure mode of the stock lifecycle:
 
 - **dead-task detection is disabled at the transport** (service
@@ -33,7 +33,16 @@ forced by a measured failure mode of the stock lifecycle:
   moment its service's socket closes during interpreter teardown (probe 4),
   so an elastic process must leave via ``os._exit`` after flushing — the
   same discipline tests/distributed_worker.py's peer_kill mode already
-  uses for exactly this reason.
+  uses for exactly this reason;
+- **the coordination service never shares a process with a member**
+  (r20, ``parallel/service_host.py``): a member-hosted service socket
+  closes with its host, and every LIVE client's error-poll thread answers
+  ``Socket closed`` with the same ``client.h:80`` LOG(FATAL) within
+  milliseconds — faster than any watchdog, which made the service owner
+  the fleet's last single point of failure (probe 5). Each epoch's pid-0
+  member SPAWNS the service as a detached jaxlib-only subprocess that
+  outlives every member and self-reaps once the membership beacon has
+  been gone past the linger window (``TWTML_ELASTIC_SERVICE_LINGER_S``).
 
 Epoch e's coordinator listens on ``base_port + 2 + e`` (base_port is the
 ``--master twtml://host:port`` port; +1 is the membership beacon); every
@@ -49,6 +58,32 @@ untouched) used only when the in-band flag row cannot work: wedge reports
 after a peer death (the dead peer can never ack in-band), join requests
 from parked/restarted hosts, and plan polling while a host is outside the
 group. Healthy ticks never touch it.
+
+**Lead election (r20, ISSUE 17)**: the lead is no longer special. The
+beacon PORT is the election lock — exactly one process can bind
+``base + 1``, and the OS arbitrates the race atomically. A dead lead's
+socket closes with it (``os._exit`` releases the fd), so survivors whose
+wedge reports hit connection-refused know the beacon is ORPHANED (a
+merely-paused lead's beacon thread still answers — pause never triggers
+an election) and run the successor rule: candidates rank by uid in the
+committed view, each waits rank × stagger while probing, then tries the
+bind — so the lowest LIVE uid wins deterministically and every loser
+observes the winner's beacon instead. The winner adopts ``lead_uid``,
+publishes the rescue plan, and restores fleet state from its own
+verified checkpoint (every elastic host shadow-saves — the
+any-host-can-restore discipline, apps/common.AppCheckpoint). Because
+the successor is the lowest live uid, it is also pid 0 of the epoch it
+forms — service spawner, broadcast authority, and beacon owner stay one
+host by construction (the service itself runs fate-isolated in its own
+subprocess, so no lead's death ever closes a live epoch's socket). Leadership is STICKY thereafter: a rejoining
+ex-lead is admitted as a follower (demotion is just "your uid is no
+longer the elected lead's"), so ``lead_uid`` only moves at elections.
+
+Reachability note: election assumes the beacon/coordinator ``host:port``
+space stays bindable wherever a lead lands — true for the virtual
+(single-machine) fleets the proof harness runs, or for real fleets
+fronted by a shared address (VIP/DNS). A lead pinned to one machine's
+address keeps the PR 13 behavior: its death is unrecoverable.
 """
 
 from __future__ import annotations
@@ -116,11 +151,12 @@ class BeaconServer:
     connection, answered from a lock-protected state dict the membership
     plane updates. Runs on a daemon thread; never touches jax."""
 
-    def __init__(self, port: int):
+    def __init__(self, port: int, lead_uid: int = 0):
         self.port = port
         self._lock = threading.Lock()
         self._state: dict = {
             "state": "forming", "epoch": 0, "members": [], "plan": None,
+            "lead_uid": int(lead_uid),
         }
         self._joins: "dict[int, float]" = {}     # uid -> monotonic seen
         self._wedged: "dict[int, int]" = {}      # uid -> epoch reported
@@ -145,8 +181,13 @@ class BeaconServer:
 
     def publish_plan(self, plan: "dict | None") -> None:
         """The committed next-epoch plan ({epoch, members}) parked/wedged
-        hosts poll for; None clears it once the epoch is live."""
+        hosts poll for; None clears it once the epoch is live. Plans carry
+        the owner's ``lead_uid`` so followers that resolve a plan through a
+        HANDED-OFF beacon adopt the elected lead in the same poll."""
         with self._lock:
+            if plan is not None:
+                plan = dict(plan)
+                plan.setdefault("lead_uid", self._state["lead_uid"])
             self._state["plan"] = plan
 
     def fresh_joins(self, max_age_s: float) -> "list[int]":
@@ -215,16 +256,21 @@ class BeaconServer:
                     "state": st["state"], "epoch": st["epoch"],
                     "members": st["members"],
                     "member": uid in st["members"],
-                    "plan": st["plan"],
+                    "plan": st["plan"], "lead_uid": st["lead_uid"],
                 }
             if op == "join":
                 self._joins[uid] = time.monotonic()
-                return {"queued": True, "epoch": st["epoch"]}
+                return {
+                    "queued": True, "epoch": st["epoch"],
+                    "lead_uid": st["lead_uid"],
+                }
             if op == "wedged":
                 self._wedged[uid] = int(req.get("epoch", -1))
-                return {"ok": True, "plan": st["plan"]}
+                return {"ok": True, "plan": st["plan"],
+                        "lead_uid": st["lead_uid"]}
             if op == "plan":
-                return {"plan": st["plan"], "epoch": st["epoch"]}
+                return {"plan": st["plan"], "epoch": st["epoch"],
+                        "lead_uid": st["lead_uid"]}
         return {"error": f"unknown op {op!r}"}
 
 
@@ -272,9 +318,12 @@ def probe_port(host: str, port: int, timeout_s: float = 0.5) -> bool:
 class ElasticRuntime:
     """Owns the per-epoch jax.distributed lifecycle for one process.
 
-    ``uid`` is this host's ORIGINAL process id (stable across epochs; the
-    launch lead, uid 0, stays the lead for the whole run — lead death is
-    the one unrecoverable loss, like the reference's Spark driver)."""
+    ``uid`` is this host's ORIGINAL process id (stable across epochs).
+    ``lead_uid`` is the CURRENT lead's uid — uid 0 at launch, then sticky
+    across epochs until an election moves it (module docstring). A
+    restarted ex-lead finds the beacon port taken by its successor, keeps
+    ``beacon=None``, and rejoins through the follower parking path —
+    demotion is just losing the bind."""
 
     def __init__(self, host: str, base_port: int, uid: int):
         self.host = host
@@ -292,9 +341,52 @@ class ElasticRuntime:
         # the error-poll LOG(FATAL) (see module docstring) — they leak for
         # the process lifetime, and finalize_exit skips teardown entirely
         self._graveyard: list = []
+        # fate-isolated coordination-service subprocesses this host
+        # spawned (parallel/service_host.py) — kept only for diagnostics;
+        # they self-reap off the beacon's liveness, never via this list
+        self._service_hosts: list = []
+        self.lead_uid = 0
         self.beacon: "BeaconServer | None" = None
         if self.uid == 0:
-            self.beacon = BeaconServer(self.beacon_port)
+            # launch-lead bind is a TRY: a restarted ex-lead races the
+            # elected successor for this port and must lose gracefully
+            # (beacon stays None → _init_elastic routes it through the
+            # follower hello/park path and it adopts the winner's lead_uid)
+            try:
+                self.beacon = BeaconServer(self.beacon_port, lead_uid=0)
+            except OSError:
+                log.warning(
+                    "beacon port :%d already owned — uid 0 restarting into "
+                    "a fleet led by an elected successor; joining as a "
+                    "follower", self.beacon_port,
+                )
+
+    @property
+    def is_lead(self) -> bool:
+        return self.uid == self.lead_uid
+
+    def set_lead(self, uid: int) -> None:
+        """Adopt ``uid`` as the current lead (from a beacon hello/plan, or
+        self after winning an election)."""
+        self.lead_uid = int(uid)
+
+    def take_over_beacon(self) -> bool:
+        """Attempt the election bind race: bind the beacon port and become
+        the lead. EXACTLY ONE caller can win (the OS arbitrates the bind);
+        a loser returns False and must re-resolve through the winner's
+        beacon. Winner adopts its own uid as ``lead_uid``."""
+        if self.beacon is not None:
+            return True
+        try:
+            self.beacon = BeaconServer(self.beacon_port, lead_uid=self.uid)
+        except OSError as exc:
+            log.info(
+                "beacon takeover lost (:%d already bound: %s) — another "
+                "survivor won the election", self.beacon_port, exc,
+            )
+            return False
+        self.lead_uid = self.uid
+        return True
 
     # -- address arithmetic --------------------------------------------------
 
@@ -316,12 +408,37 @@ class ElasticRuntime:
 
     # -- epoch lifecycle -----------------------------------------------------
 
+    def _spawn_service_host(self, port: int, nprocs: int) -> None:
+        """Launch epoch ``port``'s coordination service in a FATE-ISOLATED
+        subprocess (parallel/service_host.py): the service socket must
+        survive any member's death — including this spawner's — or every
+        survivor's client error-poll thread LOG(FATAL)s the instant it
+        closes (probe 5, doc/elastic_probe_notes.md). Detached session,
+        all stdio on /dev/null: the host must not hold a pipe a test
+        harness waits on. It self-reaps once the beacon has been gone for
+        the linger window (the run is over)."""
+        import subprocess
+        import sys
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "twtml_tpu.parallel.service_host",
+             str(port), str(nprocs), self.host, str(self.beacon_port)],
+            stdin=subprocess.DEVNULL, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL, start_new_session=True,
+        )
+        self._service_hosts.append(proc)
+        log.info(
+            "elastic coordination service for :%d hosted fate-isolated "
+            "(pid %d, %d task(s))", port, proc.pid, nprocs,
+        )
+
     def form(self, epoch: int, members: "list[int]") -> None:
         """Join epoch ``epoch`` with the given member uids (sorted; this
-        host must be one of them). Creates the coordination service on the
-        lead, a detection-disabled client everywhere, and leaves the
-        xla_bridge caches cleared so the next jax call builds the new
-        world's backend."""
+        host must be one of them). Spawns the epoch's fate-isolated
+        coordination service from the pid-0 slot, creates a
+        detection-disabled client everywhere, and leaves the xla_bridge
+        caches cleared so the next jax call builds the new world's
+        backend."""
         from jax._src import distributed as _dist
         from jax._src.lib import xla_extension as _xe
 
@@ -337,11 +454,7 @@ class ElasticRuntime:
         coordinator = f"{self.host}:{port}"
         state = _dist.global_state
         if pid == 0:
-            state.service = _xe.get_distributed_runtime_service(
-                f"[::]:{port}", nprocs,
-                heartbeat_interval=_HEARTBEAT_INTERVAL_S,
-                max_missing_heartbeats=_HEARTBEAT_DISABLED,
-            )
+            self._spawn_service_host(port, nprocs)
         client = _xe.get_distributed_runtime_client(
             coordinator, pid,
             init_timeout=_init_timeout_s(),

@@ -578,12 +578,19 @@ class MultiHostTenantModel:
     psum-global; ONE pooled fetch per tick, exactly like single-host.
 
     The stacked wire is the only multi-host tenant wire (the coalesced
-    group buffer has no tenant-axis layout across processes) and the
-    padded/unit wires are the only formats (the ragged tenant split would
-    need per-tenant cross-host bucket agreement — rejected loudly in
-    apps/common.build_model). Elastic membership (``--elastic on``)
-    rebuilds this wrapper in place across epochs via ``rebuild``, the
-    same contract as MultiHostSGDModel."""
+    group buffer has no tenant-axis layout across processes). The RAGGED
+    tenant split (r20, lifting the padded-only rejection) needs every
+    tenant part on every host to share ONE per-shard unit capacity before
+    stacking — agreed by a single allgather-max of this host's max
+    per-part need (the ``[need]`` widening template: the agree collective
+    rides the same once-per-batch cadence the single-model ragged wire
+    already pays, zero new collectives). The stacked assembly then mirrors
+    ``MultiHostSGDModel.step_many``'s ragged branch: rows shard on axis 1
+    under ``P(None, data)``, per-shard segments land on their devices, and
+    the stacked wire ships raw (the codec rides the packed one-buffer
+    forms only — same rule as the single-host stacked wire). Elastic
+    membership (``--elastic on``) rebuilds this wrapper in place across
+    epochs via ``rebuild``, the same contract as MultiHostSGDModel."""
 
     accepts_packed = False  # stacked tenant wire only across processes
 
@@ -647,12 +654,83 @@ class MultiHostTenantModel:
             to_global(a, s) for a, s in zip(stacked, specs)
         ))
 
+    def _stack_ragged_parts(self, parts):
+        """M ragged tenant parts → ONE [M]-stacked, LOCAL-shard-aligned
+        ragged batch. Stacking needs every part to share one per-shard
+        unit capacity, and the fleet needs every HOST to share it too:
+        one allgather-max of this host's max per-part need agrees it
+        (the ``[need]`` widening template — the same once-per-batch
+        collective cadence as the single-model ragged wire). Units
+        harmonize to uint16 first, the pre-codec multi-host schema rule
+        (a uint8 host next to a uint16 host must not fork signatures)."""
+        local_shards = max(1, self.num_data // jax.process_count())
+        from ..features.batch import align_ragged_shards, ragged_shard_bucket
+
+        parts = [
+            p if p.units.dtype == np.uint16 else RaggedUnitBatch(
+                np.asarray(p.units, np.uint16), p.offsets, p.numeric,
+                p.label, p.mask, row_len=p.row_len, num_shards=p.num_shards,
+            )
+            for p in parts
+        ]
+        need = max(ragged_shard_bucket(p, local_shards) for p in parts)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            need = int(
+                multihost_utils.process_allgather(
+                    np.array([need], np.int64)
+                ).max()
+            )
+        return stack_batches([
+            align_ragged_shards(p, local_shards, unit_bucket=need)
+            for p in parts
+        ])
+
+    def _to_global_ragged(self, stacked):
+        """[M]-stacked local-shard ragged wire → the global tenant wire:
+        every leaf assembles on the ROW axis (axis 1) under ``P(None,
+        data)``, exactly ``MultiHostSGDModel.step_many``'s ragged branch
+        with K = M tenants — each process contributes its local shards'
+        segments and the data axis hands every device its own."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(
+            self.mesh, P(None, self.mesh.axis_names[0])
+        )
+
+        def to_global(host_arr):
+            host_arr = np.asarray(host_arr)
+            global_shape = (
+                host_arr.shape[0],
+                host_arr.shape[1] * jax.process_count(),
+            ) + host_arr.shape[2:]
+            return jax.make_array_from_process_local_data(
+                sharding, host_arr, global_shape
+            )
+
+        return RaggedUnitBatch(
+            *(to_global(a) for a in (
+                stacked.units, stacked.offsets, stacked.numeric,
+                stacked.label, stacked.mask,
+            )),
+            row_len=stacked.row_len, num_shards=self.num_data,
+        )
+
     def step(self, local_batch) -> StepOutput:
         """Route + split THIS host's rows, stack, assemble the global
         tenant wire on the row axis, and run the stacked program. Dispatch
         only — the host transfer lives in ``fetch_output`` (the r3 law:
         the main thread never blocks a transport round trip)."""
         parts = self.inner.split(local_batch)
+        if isinstance(parts[0], RaggedUnitBatch):
+            # ragged tenant wire (r20): shared-bucket aligned stack; the
+            # 1-process degenerate epoch skips only the row-axis assembly
+            # (the aligned stack IS the single-host placement input)
+            stacked = self._stack_ragged_parts(parts)
+            if jax.process_count() == 1:
+                return self.inner.step(stacked)
+            return self.inner.step(self._to_global_ragged(stacked))
         stacked = stack_batches(parts)
         if jax.process_count() == 1:
             # degenerate epoch (an elastic fleet shrunk to one host): the

@@ -809,8 +809,18 @@ class StreamingContext:
             tick_no += 1
             # --chaos peer.kill/peer.pause: membership churn injectable
             # from the CLI like every other fault (streaming/faults.py) —
-            # a hard exit or a long stall at a deterministic tick
-            _faults.lockstep_chaos(tick_no, self.batch_interval)
+            # a hard exit or a long stall at a deterministic tick. The uid
+            # selector (peer.kill:uid=N) targets the ORIGINAL process id,
+            # stable across elastic epochs, so one shared --chaos spec
+            # kills/pauses specific hosts (the lead included) from a
+            # fleet-wide command line.
+            _faults.lockstep_chaos(
+                tick_no, self.batch_interval,
+                uid=(
+                    self.membership.uid if self.membership is not None
+                    else jax.process_index()
+                ),
+            )
             local = self._drain(limit)
             rows = sum(getattr(s, "rows", 1) for s in local)
             more = (not self._source.exhausted) or self._queue.rows_queued > 0

@@ -90,15 +90,20 @@ class InjectedFault(ConnectionError):
 class _ChaosRule:
     """One parsed ``TARGET:ACTION[@TRIGGER]`` clause."""
 
-    __slots__ = ("target", "kind", "value", "mode", "param")
+    __slots__ = ("target", "kind", "value", "mode", "param", "uid")
 
     def __init__(self, target: str, kind: str, value: float, mode: str,
-                 param: float):
+                 param: float, uid: int = -1):
         self.target = target
         self.kind = kind  # "delay" | "error"
         self.value = value  # sleep seconds (delay only)
         self.mode = mode  # "every" | "prob" | "from"
         self.param = param
+        # peer.* host selector: fire only on the host whose ORIGINAL
+        # process uid matches (-1 = every host). This is what makes
+        # kill-the-lead expressible from one fleet-wide --chaos spec:
+        # peer.kill:uid=0:tick=4 kills exactly the launch lead.
+        self.uid = int(uid)
 
     def fires(self, call_index: int, rng: random.Random) -> bool:
         if self.mode == "every":
@@ -107,9 +112,13 @@ class _ChaosRule:
             return call_index >= int(self.param)
         return rng.random() < self.param
 
+    def on_host(self, uid: int) -> bool:
+        return self.uid < 0 or self.uid == int(uid)
+
     def __repr__(self) -> str:  # shows up in the install log line
+        sel = f" uid={self.uid}" if self.uid >= 0 else ""
         if self.kind == "kill":
-            return f"{self.target} (at lockstep tick {int(self.value)})"
+            return f"{self.target}{sel} (at lockstep tick {int(self.value)})"
         act = (
             "error" if self.kind == "error"
             else "inject" if self.kind == "inject"
@@ -118,7 +127,7 @@ class _ChaosRule:
         )
         trig = {"every": "every %d", "from": "from call %d on",
                 "prob": "p=%g"}[self.mode] % self.param
-        return f"{self.target}:{act} ({trig})"
+        return f"{self.target}{sel}:{act} ({trig})"
 
 
 def _parse_trigger(text: str) -> "tuple[str, float]":
@@ -166,35 +175,43 @@ class ChaosInjector:
                 )
             mode, param = _parse_trigger(trigger) if trigger else ("every", 1)
             if target in PEER_TARGETS:
-                # membership churn: peer.kill[:tick=N] hard-exits this host
-                # at lockstep tick N (default 1); peer.pause[:ticks=K]
-                # stalls it for ~K ticks' wall time at the trigger's ticks
-                if target == "peer.kill":
-                    if action and not action.startswith("tick="):
+                # membership churn: peer.kill[:uid=U][:tick=N] hard-exits
+                # host U (every host when no uid) at lockstep tick N
+                # (default 1); peer.pause[:uid=U][:ticks=K] stalls it for
+                # ~K ticks' wall time at the trigger's ticks. Parts are
+                # colon-separated and order-free.
+                count_key = "tick" if target == "peer.kill" else "ticks"
+                uid, value = -1, None
+                for part in filter(None, action.split(":")):
+                    key, eq, num = part.partition("=")
+                    if not eq or key not in ("uid", count_key):
                         raise ValueError(
-                            f"bad chaos action {action!r} in {clause!r}: "
-                            "peer.kill takes tick=N"
+                            f"bad chaos action {part!r} in {clause!r}: "
+                            f"{target} takes {count_key}=N and uid=U"
                         )
-                    value = int(action.partition("=")[2]) if action else 1
-                    if value < 1:
-                        raise ValueError(f"non-positive tick in {clause!r}")
+                    if key == "uid":
+                        uid = int(num)
+                        if uid < 0:
+                            raise ValueError(
+                                f"negative uid in {clause!r}"
+                            )
+                    else:
+                        value = int(num)
+                        if value < 1:
+                            raise ValueError(
+                                f"non-positive {count_key} in {clause!r}"
+                            )
+                if target == "peer.kill":
+                    value = 1 if value is None else value
                     rules.append(
-                        _ChaosRule(target, "kill", value, "every", value)
+                        _ChaosRule(target, "kill", value, "every", value,
+                                   uid=uid)
                     )
                 else:
-                    if action and not action.startswith("ticks="):
-                        raise ValueError(
-                            f"bad chaos action {action!r} in {clause!r}: "
-                            "peer.pause takes ticks=K"
-                        )
-                    value = (
-                        int(action.partition("=")[2]) if action
-                        else PAUSE_DEFAULT_TICKS
-                    )
-                    if value < 1:
-                        raise ValueError(f"non-positive ticks in {clause!r}")
+                    value = PAUSE_DEFAULT_TICKS if value is None else value
                     rules.append(
-                        _ChaosRule(target, "pause", value, mode, param)
+                        _ChaosRule(target, "pause", value, mode, param,
+                                   uid=uid)
                     )
                 continue
             if target in SOURCE_TARGETS:
@@ -306,28 +323,32 @@ class ChaosInjector:
     def calls(self, target: str) -> int:
         return self._calls.get(target, 0)
 
-    def peer_chaos(self, tick: int, interval: float) -> None:
+    def peer_chaos(self, tick: int, interval: float, uid: int = -1) -> None:
         """``peer.kill``/``peer.pause`` injection, driven by the lockstep
         scheduler once per tick (the TICK NUMBER is the call index —
         deterministic on every host, so a rule fires at the same point of
-        each host's own loop). A kill is a HARD exit (``os._exit`` with
-        ``PEER_KILL_EXIT_CODE``): no abort broadcast, no goodbye — exactly
-        the failure the peer watchdog + elastic rescue path exist for. A
-        pause sleeps ~K ticks' worth of wall time (``K x max(interval,
-        0.5s)``), long enough to trip the peer watchdog when K x interval
-        exceeds ``TWTML_LOCKSTEP_TIMEOUT_S``."""
+        each host's own loop). ``uid`` is this host's original process id;
+        rules with a uid selector fire only on the matching host. A kill
+        is a HARD exit (``os._exit`` with ``PEER_KILL_EXIT_CODE``): no
+        abort broadcast, no goodbye — exactly the failure the peer
+        watchdog + elastic rescue path exist for. A pause sleeps ~K ticks'
+        worth of wall time (``K x max(interval, 0.5s)``), long enough to
+        trip the peer watchdog when K x interval exceeds
+        ``TWTML_LOCKSTEP_TIMEOUT_S``."""
         from ..telemetry import blackbox as _blackbox
         from ..telemetry import metrics as _metrics
 
         for r in self._rules.get("peer.kill", ()):
-            if tick == int(r.value):
+            if tick == int(r.value) and r.on_host(uid):
                 log.critical(
                     "chaos: peer.kill firing at lockstep tick %d — hard "
                     "exit %d (no abort broadcast)", tick,
                     PEER_KILL_EXIT_CODE,
                 )
                 _metrics.get_registry().counter("chaos.injected").inc()
-                _blackbox.record("chaos", target="peer.kill", tick=tick)
+                _blackbox.record(
+                    "chaos", target="peer.kill", tick=tick, uid=uid,
+                )
                 import os as _os
                 import sys as _sys
 
@@ -338,7 +359,11 @@ class ChaosInjector:
         if not rules:
             return
         with self._lock:
+            # every host draws the SAME rng sequence (rules evaluate before
+            # the uid filter) so uid-selected rules never desynchronize the
+            # prob-mode draws of unselected rules across the fleet
             fired = [r for r in rules if r.fires(tick, self._rng)]
+        fired = [r for r in fired if r.on_host(uid)]
         for r in fired:
             dur = int(r.value) * max(float(interval), 0.5)
             _metrics.get_registry().counter("chaos.injected").inc()
@@ -387,12 +412,13 @@ def perturb(target: str) -> None:
         _CHAOS.perturb(target)
 
 
-def lockstep_chaos(tick: int, interval: float) -> None:
+def lockstep_chaos(tick: int, interval: float, uid: int = -1) -> None:
     """``peer.*`` injection point, called by the lockstep scheduler at the
-    top of every tick (streaming/context._lockstep_loop). No-op unless a
-    chaos spec with peer rules is installed."""
+    top of every tick (streaming/context._lockstep_loop) with this host's
+    original process uid. No-op unless a chaos spec with peer rules is
+    installed."""
     if _CHAOS is not None:
-        _CHAOS.peer_chaos(tick, interval)
+        _CHAOS.peer_chaos(tick, interval, uid=uid)
 
 
 # -- source/parse injection points (r7 — the ingest-guard failure domain) ----

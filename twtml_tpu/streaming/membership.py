@@ -24,6 +24,15 @@ beacon, the lead takes (reporters ∪ itself) ∩ members as the survivor set,
 publishes the rescue plan, and everyone re-forms. The beacon is host-side
 TCP — never a collective, never touched on a healthy tick.
 
+When the DEAD peer is the lead itself (r20: the last single point of
+failure), the wedge reports hit connection-refused — the beacon died with
+its owner — and the survivors run ``_elect``: rank-staggered candidates
+race ``take_over_beacon()`` (the OS bind on the beacon port is the
+election lock), the lowest live uid wins, adopts ``lead_uid``, and runs
+the SAME lead-rescue machinery; losers re-report to the winner's beacon.
+Leadership is sticky from then on — a rejoining ex-lead parks, adopts the
+winner from the beacon's responses, and trains as a follower.
+
 Columns (float64-exact ints, appended between the 4 lockstep flags and the
 telemetry sideband):
 
@@ -79,6 +88,24 @@ PARK_TIMEOUT_DEFAULT_S = 120.0
 # wedge the new epoch's formation on a no-show
 JOIN_FRESH_S = 5.0
 
+# election: successor candidates rank by uid and each waits rank × stagger
+# (probing the orphaned beacon port throughout) before attempting the bind,
+# so the lowest LIVE uid wins the race deterministically; the OS bind is
+# the lock, the stagger only prevents needless bind contention
+ELECT_STAGGER_ENV = "TWTML_ELASTIC_ELECT_STAGGER_S"
+ELECT_STAGGER_DEFAULT_S = 0.3
+
+# bounded election rounds: each retry means the beacon owner died again
+# mid-election; three corpses in one rescue window is a lost fleet
+ELECT_MAX_ROUNDS = 3
+
+
+def election_candidates(members, lead_uid) -> "list[int]":
+    """Successor order for a dead lead: every OTHER member of the committed
+    view, ascending uid — rank in this list is the election stagger slot.
+    Pure (unit-tested directly); dead candidates simply never bind."""
+    return sorted(int(u) for u in members if int(u) != int(lead_uid))
+
 
 class MembershipPlane:
     """One per lockstep run on every host. The scheduler drives it:
@@ -105,7 +132,6 @@ class MembershipPlane:
         self.evict_skew_ms = float(evict_skew_ms)
         self.rejoin = bool(rejoin)
         self.uid = runtime.uid
-        self.lead = runtime.uid == 0
         # active proposal state (lead publishes; everyone tracks)
         self._prop_epoch = 0
         self._prop_view = 0
@@ -120,12 +146,16 @@ class MembershipPlane:
         reg = _metrics.get_registry()
         self._epoch_gauge = reg.gauge("elastic.epoch")
         self._hosts_gauge = reg.gauge("elastic.live_hosts")
+        self._lead_gauge = reg.gauge("elastic.lead_uid")
         self._reforms = reg.counter("elastic.reforms")
         self._departed = reg.counter("elastic.hosts_departed")
         self._rejoined = reg.counter("elastic.hosts_rejoined")
         self._rows_lost = reg.counter("elastic.rows_lost_estimate")
+        self._elections = reg.counter("elastic.elections")
+        self._handoffs = reg.counter("elastic.lead_handoffs")
         self._epoch_gauge.set(runtime.epoch)
         self._hosts_gauge.set(len(runtime.members))
+        self._lead_gauge.set(self.lead_uid)
 
     # -- helpers -------------------------------------------------------------
 
@@ -136,6 +166,37 @@ class MembershipPlane:
     @property
     def members(self) -> "list[int]":
         return self.runtime.members
+
+    @property
+    def lead_uid(self) -> int:
+        return int(getattr(self.runtime, "lead_uid", 0))
+
+    @property
+    def lead(self) -> bool:
+        """Whether THIS host is the current lead. Dynamic — leadership is
+        sticky on ``runtime.lead_uid`` and only moves at an election (a
+        rejoining ex-lead stays a follower even though its uid is again
+        the minimum)."""
+        return self.uid == self.lead_uid
+
+    def _adopt_lead(self, resp: "dict | None", how: str) -> None:
+        """Adopt the lead uid a beacon response advertises. Any response
+        from a HANDED-OFF beacon carries the winner's uid; counting the
+        change here gives every survivor/rejoiner its own handoff record
+        (``elastic.lead_handoffs``)."""
+        if not resp or "lead_uid" not in resp:
+            return
+        new = int(resp["lead_uid"])
+        if new == self.lead_uid:
+            return
+        old = self.lead_uid
+        self.runtime.set_lead(new)
+        self._lead_gauge.set(new)
+        self._handoffs.inc()
+        log.warning(
+            "elastic: lead handoff observed (%s): uid %d -> uid %d",
+            how, old, new,
+        )
 
     @staticmethod
     def _grace_s() -> float:
@@ -215,9 +276,10 @@ class MembershipPlane:
             self.members[pid]
             if 0 <= pid < len(self.members) else -1
         )
-        if uid <= 0 or skew < self.evict_skew_ms:
-            # uid 0 is the lead (never evicted: it owns the beacon and the
-            # checkpoint truth); reset the run
+        if uid < 0 or uid == self.lead_uid or skew < self.evict_skew_ms:
+            # the CURRENT lead is never evicted (it owns the beacon and
+            # the checkpoint truth — losing it is an election, not an
+            # eviction); reset the run
             self._gating_uid, self._gating_ticks = -1, 0
             return -1
         if uid == self._gating_uid:
@@ -239,9 +301,17 @@ class MembershipPlane:
                          evicted): call ``park`` now
         """
         rows = np.asarray(mem, dtype=np.int64)
-        lead_prop = int(rows[0, FIELDS.index("prop_epoch")])
-        lead_view = int(rows[0, FIELDS.index("prop_view")])
-        lead_reason = int(rows[0, FIELDS.index("reason")])
+        # proposals are read from the LEAD's row. After an election the
+        # lead is no longer pid 0 whenever a lower uid rejoined (the
+        # ex-lead comes back as a follower but still sorts first), so the
+        # row index follows lead_uid through the member list.
+        lead_pid = (
+            self.members.index(self.lead_uid)
+            if self.lead_uid in self.members else 0
+        )
+        lead_prop = int(rows[lead_pid, FIELDS.index("prop_epoch")])
+        lead_view = int(rows[lead_pid, FIELDS.index("prop_view")])
+        lead_reason = int(rows[lead_pid, FIELDS.index("reason")])
         if lead_prop > self.epoch:
             # record/refresh the proposal; ack it from the NEXT tick on
             self._prop_epoch = lead_prop
@@ -373,10 +443,15 @@ class MembershipPlane:
             if resp is None:
                 time.sleep(1.0)
                 continue
+            # a parked ex-lead learns its successor here — admission into
+            # a post-election fleet is the demotion path (the beacon that
+            # answers is the winner's)
+            self._adopt_lead(resp, "parked")
             plan = (client.request("plan", self.uid) or {}).get("plan")
             if plan and self.uid in plan.get("members", []) and (
                 plan["epoch"] > self.epoch
             ):
+                self._adopt_lead(plan, "admission plan")
                 plan = dict(plan, reason="rejoin")
                 self._attach(plan, "rejoin")
                 self._finish_transition(old, "rejoin")
@@ -404,11 +479,11 @@ class MembershipPlane:
             return self._rescue_lead(why)
         return self._rescue_follower(why)
 
-    def _rescue_lead(self, why: str) -> bool:
+    def _rescue_lead(self, why: str, extra_grace_s: float = 0.0) -> bool:
         beacon = self.runtime.beacon
         if beacon is None:
             return False
-        grace = self._grace_s()
+        grace = self._grace_s() + float(extra_grace_s)
         log.critical(
             "elastic: lockstep wedged (%s); collecting survivor reports "
             "for %.1fs before shrinking", why, grace,
@@ -444,17 +519,22 @@ class MembershipPlane:
         self._plan = None
         return True
 
-    def _rescue_follower(self, why: str) -> bool:
+    def _rescue_follower(self, why: str, round_no: int = 0) -> bool:
         client = self.runtime.beacon_client()
         wedge_epoch = self.epoch
         resp = client.request("wedged", self.uid, epoch=wedge_epoch)
         if resp is None:
+            # the beacon is ORPHANED: a merely-paused lead's beacon thread
+            # still answers, so an unreachable beacon means the lead DIED
+            # with it. PR 13 aborted here ("the lead is this fleet's
+            # driver"); the survivors now elect a successor instead.
             log.critical(
                 "elastic: lockstep wedged (%s) and the lead's beacon is "
-                "unreachable — the lead is gone; membership cannot be "
-                "coordinated (the lead is this fleet's driver)", why,
+                "unreachable — the lead (uid %d) is gone; electing a "
+                "successor from the committed view", why, self.lead_uid,
             )
-            return False
+            return self._elect(why, round_no)
+        self._adopt_lead(resp, "wedge report")
         # wait for the lead's plan: its grace window + margin
         deadline = time.monotonic() + self._grace_s() + max(
             10.0, self._grace_s()
@@ -474,6 +554,7 @@ class MembershipPlane:
                     # the group moved on without us (we were presumed
                     # dead — e.g. a long GC pause): park and rejoin
                     return self.park()
+                self._adopt_lead(plan, "rescue plan")
                 plan = dict(plan, reason="rescue")
                 self._plan = plan
                 self._detach(clean=False)
@@ -483,8 +564,86 @@ class MembershipPlane:
                 return True
             time.sleep(0.3)
             resp = client.request("wedged", self.uid, epoch=wedge_epoch)
+        from ..parallel.elastic import probe_port
+
+        if not probe_port(self.runtime.host, self.runtime.beacon_port):
+            # the lead died DURING the window (answered the first wedge
+            # report, then went down): the beacon is orphaned now — elect
+            log.critical(
+                "elastic: the lead's beacon went dark mid-rescue (%s); "
+                "electing a successor", why,
+            )
+            return self._elect(why, round_no)
         log.critical(
             "elastic: no rescue plan from the lead within the window (%s)",
             why,
         )
         return False
+
+    def _elect(self, why: str, round_no: int = 0) -> bool:
+        """Lead election over the orphaned beacon port (the lead died; its
+        ``os._exit`` released the bind). Deterministic successor rule: the
+        candidates are every OTHER member of the committed view ascending
+        by uid; each waits rank × stagger while probing the port, then
+        races ``take_over_beacon()`` — the OS bind arbitrates, so exactly
+        one survivor wins (the lowest LIVE uid, because lower ranks bind
+        first and dead candidates never do). The winner runs the normal
+        lead rescue (losers' wedge reports land on ITS beacon within the
+        grace window); losers re-enter the follower rescue against the
+        winner's beacon."""
+        if round_no >= ELECT_MAX_ROUNDS:
+            log.critical(
+                "elastic: %d election rounds exhausted (%s) — every "
+                "successor died mid-election; aborting", round_no, why,
+            )
+            return False
+        from ..parallel.elastic import probe_port
+        from ..telemetry import blackbox as _blackbox
+
+        candidates = election_candidates(self.members, self.lead_uid)
+        if self.uid not in candidates:
+            return False  # not in the committed view — nothing to lead
+        rank = candidates.index(self.uid)
+        stagger = float(
+            os.environ.get(ELECT_STAGGER_ENV, "") or ELECT_STAGGER_DEFAULT_S
+        )
+        _blackbox.record(
+            "lead_election", epoch=self.epoch, uid=self.uid, rank=rank,
+            candidates=candidates, dead_lead=self.lead_uid, why=why,
+        )
+        log.warning(
+            "elastic: election — uid %d is successor rank %d of %s "
+            "(stagger %.1fs)", self.uid, rank, candidates, rank * stagger,
+        )
+        deadline = time.monotonic() + rank * stagger
+        while time.monotonic() < deadline:
+            if probe_port(self.runtime.host, self.runtime.beacon_port,
+                          timeout_s=0.2):
+                # a lower-ranked survivor already owns the beacon: follow
+                return self._rescue_follower(why, round_no + 1)
+            time.sleep(0.1)
+        old_lead = self.lead_uid
+        if not self.runtime.take_over_beacon():
+            # lost the bind race — the winner's beacon is up; follow it
+            return self._rescue_follower(why, round_no + 1)
+        self._lead_gauge.set(self.uid)
+        self._elections.inc()
+        self._handoffs.inc()
+        _blackbox.record(
+            "lead_elected", epoch=self.epoch, uid=self.uid,
+            dead_lead=old_lead, why=why,
+        )
+        _blackbox.record(
+            "beacon_handoff", port=self.runtime.beacon_port,
+            from_uid=old_lead, to_uid=self.uid,
+        )
+        log.critical(
+            "elastic: uid %d WON the election (beacon :%d re-bound, "
+            "ex-lead uid %d) — coordinating the rescue as the new lead",
+            self.uid, self.runtime.beacon_port, old_lead,
+        )
+        # the losers' probes see the bind within one stagger step; the
+        # grace window stretches by the full stagger span so even the
+        # highest-ranked live candidate's re-report lands inside it
+        self.runtime.beacon.publish("rescuing", self.epoch, self.members)
+        return self._rescue_lead(why, extra_grace_s=stagger * len(candidates))

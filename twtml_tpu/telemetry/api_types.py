@@ -92,6 +92,9 @@ class Hosts:
     liveHosts: int = 0
     departed: int = 0
     rejoined: int = 0
+    # r20: the CURRENT lead's uid — uid 0 at launch, moves only at a won
+    # election (streaming/membership.py); -1 when the run is not elastic
+    leadUid: int = -1
 
     json_class = "Hosts"
 

@@ -264,6 +264,7 @@ class SessionStats:
                     live_hosts=int(gauges.get("elastic.live_hosts", 0)),
                     departed=int(counters.get("elastic.hosts_departed", 0)),
                     rejoined=int(counters.get("elastic.hosts_rejoined", 0)),
+                    lead_uid=int(gauges.get("elastic.lead_uid", -1)),
                 )
                 self._web_breaker.record_success()
             except Exception:

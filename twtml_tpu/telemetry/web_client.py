@@ -86,15 +86,18 @@ class WebClient:
 
     def hosts(self, hosts: list, straggler: int = -1, stage: str = "",
               skew_ms: float = 0.0, epoch: int = -1, live_hosts: int = 0,
-              departed: int = 0, rejoined: int = 0) -> None:
+              departed: int = 0, rejoined: int = 0,
+              lead_uid: int = -1) -> None:
         """Push the per-host lockstep sideband view for the dashboard's
         Hosts tile row (additive message; telemetry/sideband.py), plus the
         elastic membership summary (epoch, live host count, cumulative
-        departed/rejoined — streaming/membership.py gauges)."""
+        departed/rejoined, and the current lead's uid — it moves at a won
+        election; streaming/membership.py gauges)."""
         self._post(Hosts(hosts=list(hosts), straggler=int(straggler),
                          stage=str(stage), skewMs=float(skew_ms),
                          epoch=int(epoch), liveHosts=int(live_hosts),
-                         departed=int(departed), rejoined=int(rejoined)))
+                         departed=int(departed), rejoined=int(rejoined),
+                         leadUid=int(lead_uid)))
 
     def tenants(self, tenants: list, gating: int = -1, active: int = 0) -> None:
         """Push the per-tenant model-plane view for the dashboard's Tenants

@@ -98,11 +98,13 @@
     document.getElementById("tickSkew").textContent =
       String(json.skewMs || 0);
     // elastic membership (streaming/membership.py): epoch + live host
-    // count, cumulative churn; "—" when the run is not elastic
+    // count + the current lead (moves at a won election), cumulative
+    // churn; "—" when the run is not elastic
     const elastic = Number(json.epoch) >= 0;
     document.getElementById("elasticEpoch").textContent = elastic
       ? json.epoch + " · " + (json.liveHosts || 0) + " host" +
-        ((json.liveHosts || 0) === 1 ? "" : "s")
+        ((json.liveHosts || 0) === 1 ? "" : "s") +
+        (Number(json.leadUid) >= 0 ? " · lead " + json.leadUid : "")
       : "—";
     document.getElementById("elasticChurn").textContent = elastic
       ? (json.departed || 0) + " / " + (json.rejoined || 0)
