@@ -99,13 +99,14 @@ class TestAppResume:
     def test_linear_app_checkpoints_and_resumes(self, tmp_path, capsys):
         from twtml_tpu.apps.linear_regression import run
 
-        def conf():
+        def conf(*extra):
             return ConfArguments().parse([
                 "--source", "replay", "--replayFile", DATA,
                 "--seconds", "1", "--backend", "cpu",
                 "--checkpointDir", str(tmp_path), "--checkpointEvery", "1",
                 "--lightning", "http://127.0.0.1:9",
                 "--twtweb", "http://127.0.0.1:9",
+                *extra,
             ])
 
         first = run(conf())
@@ -115,9 +116,25 @@ class TestAppResume:
         assert meta["count"] == 6
         assert np.abs(weights_after_first).sum() > 0
 
-        # second run resumes: cumulative count continues from 6
+        # second run over the SAME corpus is an EXACT resume (r21): with
+        # --checkpointDir the intake journal is auto-on, the boot replay
+        # fast-forwards past every journaled row the restored checkpoint
+        # already covers, and nothing double-trains — counters and
+        # weights are unchanged
         second = run(conf())
-        assert second["count"] == 12
+        assert second["count"] == 6
+        weights_after_second, meta2 = ckpt.restore()
+        assert meta2["count"] == 6
+        np.testing.assert_array_equal(
+            weights_after_first, weights_after_second
+        )
+        out = capsys.readouterr().out
+        assert "count: 6" in out
+
+        # --journal off restores the pre-r21 resume semantics bit-exactly:
+        # the corpus re-trains on top of the restored counters
+        third = run(conf("--journal", "off"))
+        assert third["count"] == 12
         out = capsys.readouterr().out
         assert "count: 12" in out
 
@@ -139,8 +156,9 @@ class TestAppResume:
         assert first["count"] == 6
         weights_after_first, meta = Checkpointer(str(tmp_path)).restore()
         assert meta["count"] == 6
+        # exact resume (r21): same corpus + auto-on journal = no new rows
         second = run(conf())
-        assert second["count"] == 12
+        assert second["count"] == 6
 
     def test_kmeans_app_checkpoints_and_resumes(self, tmp_path):
         """Cluster state (centers + decay weights) checkpoints and resumes;

@@ -199,6 +199,26 @@ def test_metrics_frame_updates_ingest_guard_tiles():
     assert "degraded" not in h.el("rollbacks").class_set
 
 
+def test_metrics_frame_updates_journal_tile():
+    """ISSUE 19 intake journal: the journal.replayed_rows counter renders on
+    the 'journal · replayed' tile — nonzero means a recovery path replayed
+    rows instead of counting them lost; a frame without it resets to 0."""
+    h = dashboard()
+    h.ws.server_open()
+    h.ws.server_message(frame(
+        jsonClass="Metrics",
+        counters={"journal.replayed_rows": 2048},
+        gauges={"journal.disk_mb": 12.5},
+        health={"phase": "healthy", "rtt_ms": 70.0, "transitions": 0},
+    ))
+    assert h.el("journalReplayed").text == "2048"
+    h.ws.server_message(frame(
+        jsonClass="Metrics", counters={}, gauges={},
+        health={"phase": "healthy", "rtt_ms": 70.0, "transitions": 0},
+    ))
+    assert h.el("journalReplayed").text == "0"
+
+
 def test_metrics_frame_updates_wire_ratio_tile():
     """r15 compressed wire: the wire.codec_ratio gauge (raw/compressed
     units bytes, apps/common._record_wire_codec) renders on the pipeline

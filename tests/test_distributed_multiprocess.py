@@ -198,18 +198,31 @@ def test_app_level_multihost_cli_trains_in_lockstep(tmp_path):
     assert meta_s["batches"] == meta_m["batches"] == len(ref)
     np.testing.assert_allclose(w_multi, w_single, rtol=1e-4, atol=1e-7)
 
-    # resume: a second multi-host run on the same dir restores the lead's
-    # checkpoint (broadcast to every process) and keeps counting
+    # resume: a second multi-host run on the same dir is an r21 EXACT
+    # resume — every host restores the lead's broadcast checkpoint and
+    # fast-forwards past its own journaled shard (the corpus is fully
+    # covered), so nothing retrains and the counters are unchanged
     multi2 = _run_app_group(
         common + ["--batchBucket", "16", "--checkpointDir", d_multi],
         nprocs=2, ndev=2,
     )
-    lead2 = stat_lines(multi2[0])
-    assert lead2, "resumed run produced no batches"
-    first = [int(x) for x in re.findall(r"-?\d+", lead2[0])]
-    assert first[0] == 200 + first[1]  # cumulative count resumed from 200
+    assert stat_lines(multi2[0]) == []  # no new batches: exactly-once
     _, meta_m2 = Checkpointer(d_multi).restore()
-    assert meta_m2["count"] == 400
+    assert meta_m2["count"] == 200
+
+    # --journal off restores the pre-r21 resume semantics: the corpus
+    # re-trains on top of the restored counters on every host
+    multi3 = _run_app_group(
+        common + ["--batchBucket", "16", "--checkpointDir", d_multi,
+                  "--journal", "off"],
+        nprocs=2, ndev=2,
+    )
+    lead3 = stat_lines(multi3[0])
+    assert lead3, "journal-off resume produced no batches"
+    first = [int(x) for x in re.findall(r"-?\d+", lead3[0])]
+    assert first[0] == 200 + first[1]  # cumulative count resumed from 200
+    _, meta_m3 = Checkpointer(d_multi).restore()
+    assert meta_m3["count"] == 400
 
 
 def test_app_level_multihost_ragged_wire(tmp_path):
@@ -503,15 +516,20 @@ def test_app_level_multihost_sentinel_rollback(tmp_path):
     lead = [ln for ln in outs[0].splitlines() if ln.startswith("count:")]
     follower = [ln for ln in outs[1].splitlines() if ln.startswith("count:")]
     assert follower == []  # one telemetry owner per run
-    # 3 global batches of 32; the poisoned 2nd is skipped, not counted
-    assert len(lead) == 2
-    assert "count: 64" in lead[-1]
+    # 3 global batches of 32; the sentinel skips the poisoned 2nd, and the
+    # r21 intake journal (auto-on with --checkpointDir) replays its rows
+    # on BOTH hosts — the journal seam sits upstream of the poison
+    # injection point, so they re-featurize clean and all 3 batches train
+    assert len(lead) == 3
+    assert "count: 96" in lead[-1]
+    for err in errs:
+        assert "journal: replayed" in err, err[-2000:]
 
     from twtml_tpu.checkpoint import Checkpointer
 
     state, meta = Checkpointer(d_ck).restore()
-    assert meta["count"] == 64
-    assert meta["batches"] == 2
+    assert meta["count"] == 96
+    assert meta["batches"] == 3
     assert np.isfinite(np.asarray(state)).all()
 
 

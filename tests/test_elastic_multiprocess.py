@@ -262,6 +262,130 @@ def test_peer_kill_shrinks_and_survivor_bitmatches_clean_run(tmp_path):
     )
 
 
+def test_shrink_replays_rolled_back_rows_from_journal(tmp_path):
+    """THE replay-after-shrink acceptance (ISSUE 19, two-process gloo):
+    with ``--checkpointEvery 2`` the newest verified archive at the kill
+    is batch 2, so the rescue THROWS BATCH 3 AWAY — discarded in-flight
+    (its collectives died with the peer) or rolled back by the resync —
+    where the pre-journal behavior counted those rows lost. With the
+    intake journal on (auto via ``--checkpointDir``), the survivor
+    re-ingests its own 16 thrown-away rows from its journal (replayed ==
+    discarded+rolled, exactly), and the continuation is BIT-EQUAL to a
+    clean run from the step-2 archive over the survivor's rows 32.. —
+    zero rows lost to the rescue."""
+    import shutil
+    import threading
+
+    path, statuses = _write_replay(tmp_path, 200)
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    keep = tmp_path / "archives"  # rotation-proof copies of every save
+    keep.mkdir()
+    stop_copier = threading.Event()
+
+    def copier():
+        seen = set()
+        while not stop_copier.is_set():
+            for f in ck.glob("ckpt-*.npz"):
+                if f.name not in seen:
+                    try:
+                        shutil.copy2(f, keep / f.name)
+                        seen.add(f.name)
+                    except OSError:
+                        pass  # racing the writer's rename; next pass wins
+            stop_copier.wait(0.05)
+
+    copier_thread = threading.Thread(target=copier, daemon=True)
+    copier_thread.start()
+
+    base = _free_port_range()
+    env = _elastic_env()
+    args = _elastic_args(path, ck, extra=["--checkpointEvery", "2"])
+    lead = _spawn_app(0, 2, base, args, env)
+    peer = _spawn_app(1, 2, base, args + ["--chaos", "peer.kill:tick=4"], env)
+    try:
+        lo, le = lead.communicate(timeout=420.0)
+        po, pe = peer.communicate(timeout=60.0)
+    finally:
+        stop_copier.set()
+        copier_thread.join(timeout=5)
+    assert peer.returncode == 77, f"peer did not chaos-exit:\n{pe[-2000:]}"
+    assert lead.returncode == 0, f"survivor failed:\n{le[-4000:]}"
+    assert "elastic epoch 1 formed: 1 host(s) [0]" in le
+
+    # the rescue threw batch 3 away — past the step-2 archive, it is
+    # either a discarded in-flight output (dispatched, never delivered:
+    # the dead peer poisoned its collectives) or delivered post-checkpoint
+    # progress the resync rolled back; both forms are counted, and the
+    # survivor's share is its 16-row batch either way
+    resync = re.search(
+        r"elastic resync: state from the lead's verified checkpoint "
+        r"\(count=(\d+), batches=(\d+), state crc ([0-9a-f]+)\)"
+        r"(?: — (\d+) row\(s\) of post-checkpoint progress rolled back)?",
+        le,
+    )
+    assert resync is not None, "survivor never logged the resync"
+    assert int(resync.group(1)) == 64 and int(resync.group(2)) == 2
+    rolled_share = int(resync.group(4) or 0) // 2  # global rows, 2 hosts
+    discarded = sum(
+        int(r) for r in re.findall(
+            r"elastic rescue: discarded \d+ in-flight.*?\(~(\d+) "
+            r"row\(s\)\)", le,
+        )
+    )
+    assert rolled_share + discarded == 16, (rolled_share, discarded)
+
+    # the journal converted the survivor's share into a replay: replayed
+    # rows == this host's thrown-away rows, exactly
+    replay = re.search(
+        r"journal: replayed (\d+) row\(s\) from cursor (\d+) after "
+        r"elastic rescue — counters reset to \(count=64, batches=2\); "
+        r"recovery is replay-exact, zero rows lost", le,
+    )
+    assert replay is not None, f"survivor never replayed:\n{le[-4000:]}"
+    assert int(replay.group(1)) == rolled_share + discarded == 16
+    assert int(replay.group(2)) == 2  # the step-2 archive's cursor stamp
+
+    # ledger: 64 restored + the survivor's rows 32.. of its 100-row shard
+    # (the replayed 16, the interrupted tick's 16, then the source tail);
+    # only the DEAD host's rolled-back+remaining rows are lost with it
+    lines = _stat_lines(lo)
+    assert lines, "survivor printed no stats"
+    final_count = int(re.findall(r"count: (\d+)", lines[-1])[0])
+    assert final_count == 132  # 64 global + host 0's remaining 68
+
+    # ---- bit-equality vs a clean run from the step-2 archive -----------
+    import jax
+
+    from twtml_tpu.apps.common import state_checksum
+    from twtml_tpu.checkpoint import Checkpointer
+    from twtml_tpu.config import ConfArguments
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.parallel import ParallelSGDModel, make_mesh
+
+    state2, meta2 = Checkpointer(str(keep)).restore(step=2)
+    assert resync.group(3) == state_checksum(state2)
+    assert meta2["journal"] == {"cursor": 2, "rows": 32}
+    conf = ConfArguments().parse(["--backend", "cpu"])
+    mesh = make_mesh(num_data=2, devices=jax.devices()[:2])
+    model = ParallelSGDModel.from_conf(conf, mesh).set_initial_weights(state2)
+    feat = Featurizer(now_ms=NOW_MS)
+    shard0 = statuses[0::2]
+    for lo_i in range(32, len(shard0), 16):
+        batch = feat.featurize_batch_ragged(
+            shard0[lo_i:lo_i + 16], row_bucket=16, unit_bucket=64,
+            row_multiple=2,
+        )
+        model.step(model.pack_for_wire(batch))
+    final_state, meta = Checkpointer(str(ck)).restore()
+    assert meta["count"] == 132
+    np.testing.assert_array_equal(
+        np.asarray(final_state), np.asarray(model.latest_weights),
+        err_msg="replayed continuation is not bit-equal to the clean "
+                "run-from-step-2-archive",
+    )
+
+
 def test_lead_kill_elects_successor_and_bitmatches_clean_run(tmp_path):
     """THE election acceptance (ISSUE 17): the LEAD hard-dies at lockstep
     tick 4 (``--chaos peer.kill:uid=0`` — one fleet-wide spec, the uid

@@ -139,10 +139,12 @@ def test_linear_app_checkpoint_cadence_under_fetch_pipeline(tmp_path):
     state, meta = Checkpointer(ck).restore()
     assert meta["batches"] == 4
     # resume: counters continue from the checkpoint (batches=4, count=64)
-    # while the replay file is re-read from the start (6 more batches)
+    # and the re-read replay file fast-forwards past the 64 journaled
+    # rows the checkpoint covers (r21 exact resume) — only the 2 batches
+    # the first run never reached train now: exactly-once over the corpus
     totals2 = app.run(ConfArguments().parse(conf_args))
-    assert totals2["batches"] == 4 + 6
-    assert totals2["count"] == 64 + 6 * 16
+    assert totals2["batches"] == 4 + 2
+    assert totals2["count"] == 64 + 2 * 16
 
 
 def test_cap_reached_still_delivers_pending_handles():

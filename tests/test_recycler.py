@@ -75,14 +75,23 @@ def test_auto_recycle_resumes_bit_identically(tmp_path):
     assert crc_restored == crc_saved
     assert count_resumed == count_r
 
-    # the second life replays the whole file (replay-source recycle
-    # semantics — documented) and the cumulative count carries across
+    # exact resume (ISSUE 19): the intake journal's boot replay
+    # fast-forwards the re-exec'd process past every row the first life
+    # journaled (SkipRowsSource) and re-ingests the post-cursor tail, so
+    # the second life trains each row EXACTLY ONCE — the pre-journal
+    # behavior re-read the whole file on top of the restored count
+    boots = re.findall(
+        r"journal: boot resume — (\d+) journaled row\(s\), (\d+) "
+        r"fast-forwarded", proc.stderr,
+    )
+    assert len(boots) == 1, proc.stderr[-3000:]
+    assert int(boots[0][0]) == int(boots[0][1])  # deterministic source
     stats = [
         ln for ln in proc.stdout.splitlines() if ln.startswith("count:")
     ]
     assert stats, proc.stdout[-2000:]
     final_count = int(re.findall(r"count: (\d+)", stats[-1])[0])
-    assert final_count == count_r + 96
+    assert final_count == 96
 
     from twtml_tpu.checkpoint import Checkpointer
 

@@ -204,11 +204,14 @@ BASE = [
 
 
 def test_acceptance_nan_chaos_rollback_matches_clean_run(tmp_path, monkeypatch):
-    """THE ISSUE 4 acceptance path: poison batch 5 of 8 via source.nan,
-    detect on the already-fetched stats with ZERO added host fetches,
-    roll back to the verified checkpoint at batch 4, skip the poisoned
-    batch, continue — final weights and counters equal a clean run over
-    the same file with the poisoned batch's rows removed."""
+    """THE ISSUE 4→19 acceptance path: poison batch 5 of 8 via source.nan,
+    detect on the already-fetched stats, roll back to the verified
+    checkpoint at batch 4, and RE-INGEST the skipped rows from the intake
+    journal (--journal auto follows --checkpointDir). source.nan injects
+    at the featurize stage — AFTER the journal seam — so the journaled
+    bytes are clean and the trigger's call index never re-fires on the
+    replay: the final weights and counters equal a clean run over the SAME
+    full file. Crash-equals-clean, zero rows lost."""
     from twtml_tpu.apps import linear_regression as app
     from twtml_tpu.checkpoint import Checkpointer
 
@@ -218,10 +221,7 @@ def test_acceptance_nan_chaos_rollback_matches_clean_run(tmp_path, monkeypatch):
 
     lines = _corpus(8 * 16, seed=51)
     poisoned_file = tmp_path / "poisoned.jsonl"
-    clean_file = tmp_path / "clean.jsonl"
     _write_lines(poisoned_file, lines)
-    # batch 5 (back-to-back 16-row buckets in file order) = rows 64..79
-    _write_lines(clean_file, lines[:64] + lines[80:])
 
     d_poison, d_clean = str(tmp_path / "ckp"), str(tmp_path / "ckc")
     totals_p, fetches_p = _run_counting_fetches(
@@ -232,31 +232,36 @@ def test_acceptance_nan_chaos_rollback_matches_clean_run(tmp_path, monkeypatch):
     reg = _metrics.get_registry()
     assert reg.counter("model.rollbacks").snapshot() == 1
     assert reg.counter("model.nonfinite_batches").snapshot() == 1
-    assert reg.counter("model.rows_lost").snapshot() == 16
+    # the journal converts the counted loss into a replay: the poisoned
+    # batch's 16 rows re-ingest from cursor 4 and train clean
+    assert reg.counter("model.rows_lost").snapshot() == 0
+    assert reg.counter("journal.replayed_rows").snapshot() == 16
+    assert reg.counter("journal.torn_tails").snapshot() == 0
     assert reg.counter("fetch.aborts").snapshot() == 0
-    # ZERO added host fetches: exactly the FetchPipeline's one per
-    # dispatched batch (8 dispatched, poisoned one included) — the
-    # sentinel reads only what was already on the host
-    assert fetches_p == 8
-    # the poisoned batch is skipped, not counted
-    assert totals_p["batches"] == 7
-    assert totals_p["count"] == 7 * 16
+    # one fetch per DISPATCHED batch and nothing else: 8 from the file +
+    # 1 re-dispatch of the replayed rows — the sentinel and the journal
+    # both read only what was already on the host
+    assert fetches_p == 9
+    # every row trains exactly once: the full-file ledger
+    assert totals_p["batches"] == 8
+    assert totals_p["count"] == 8 * 16
 
     _metrics.reset_for_tests()
     faults.uninstall_chaos()  # the injector is process-wide per --chaos run
 
     totals_c = app.run(ConfArguments().parse(
-        BASE + ["--replayFile", str(clean_file),
+        BASE + ["--replayFile", str(poisoned_file),
                 "--checkpointDir", d_clean, "--checkpointEvery", "1"]
     ))
-    assert totals_c["batches"] == 7
-    assert totals_c["count"] == 7 * 16
+    assert totals_c["batches"] == 8
+    assert totals_c["count"] == 8 * 16
 
     w_poison, meta_p = Checkpointer(d_poison).restore()
     w_clean, meta_c = Checkpointer(d_clean).restore()
-    assert meta_p["count"] == meta_c["count"] == 7 * 16
-    # rollback restore is bit-exact and the surviving batches are
-    # identical rows in identical order -> identical trajectories
+    assert meta_p["count"] == meta_c["count"] == 8 * 16
+    # rollback restore is bit-exact, the journaled bytes are the clean
+    # pre-poison rows, and replay re-runs them through the unchanged
+    # featurize path in order -> identical trajectories
     np.testing.assert_array_equal(w_poison, w_clean)
 
 
@@ -351,10 +356,15 @@ def test_superbatch_group_rollback_skips_poisoned_group(tmp_path):
                 "--chaos", "source.nan@5"]
     ))
     reg = _metrics.get_registry()
-    assert reg.counter("model.rollbacks").snapshot() == 1
+    # TWO episodes: batch 5 (featurize call 5) poisons its group (5,6) —
+    # both skipped, 32 rows replayed from the batch-4 cursor. The @5
+    # trigger is every-5th-call, so the 10th featurize call (batch 8; the
+    # replays consumed calls 7-8) poisons AGAIN — rollback to the batch-6
+    # save replays batches 7-8. Each replay re-crosses the seam BELOW the
+    # injection point and trains clean: the full-file ledger, zero lost.
+    assert reg.counter("model.rollbacks").snapshot() == 2
     assert reg.counter("fetch.aborts").snapshot() == 0
-    # batch 5 poisons its group (5,6): batch 5 NaN-stats, batch 6 trained
-    # on NaN weights -> both skipped as one episode
-    assert totals["batches"] == 6
-    assert totals["count"] == 6 * 16
-    assert reg.counter("model.rows_lost").snapshot() == 2 * 16
+    assert totals["batches"] == 8
+    assert totals["count"] == 8 * 16
+    assert reg.counter("model.rows_lost").snapshot() == 0
+    assert reg.counter("journal.replayed_rows").snapshot() == 4 * 16

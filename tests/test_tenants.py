@@ -526,8 +526,17 @@ def test_app_m4_sentinel_rolls_back_stacked_plane(tmp_path, monkeypatch):
         faults.uninstall_chaos()
     reg = _metrics.get_registry()
     assert reg.counter("model.rollbacks").snapshot() == 1
-    assert totals["batches"] == 7  # the poisoned batch is skipped
-    assert fetches == 8  # zero ADDED fetches: sentinel reads fetched stats
+    # the sentinel skips the poisoned batch, and the r21 intake journal
+    # replays its rows from disk (the journal seam sits upstream of the
+    # poison injection point, so they re-featurize clean): all 8 batches
+    # of the corpus end up trained, zero rows lost
+    assert totals["batches"] == 8
+    assert totals["count"] == 128
+    assert reg.counter("model.rows_lost").snapshot() == 0
+    assert reg.counter("journal.replayed_rows").snapshot() > 0
+    # zero ADDED fetches: sentinel reads fetched stats — one fetch per
+    # DISPATCHED batch (8 original + 1 replayed)
+    assert fetches == 9
 
 
 def test_conf_flags():

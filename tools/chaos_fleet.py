@@ -245,6 +245,59 @@ def run_storm(
             "hosts were killed but no survivor counted rows_lost_estimate"
         )
 
+    # -- replay-exact reforms (ISSUE 19): on every reform, each survivor's
+    # journal replay re-covers EXACTLY what the rescue threw away — its
+    # own discarded in-flight rows plus its share of the rolled-back
+    # post-checkpoint progress (global rows, evenly sharded across the
+    # pre-reform members). The journal is on in every storm
+    # (--checkpointDir implies --journal auto), so a missing replay line
+    # means a loss site stayed counted instead of converted.
+    replayed_rows = 0
+    for uid, e in enumerate(errs):
+        resyncs = re.findall(
+            r"elastic resync: state from the lead's [a-z ]+ "
+            r"\(count=\d+, batches=\d+, state crc [0-9a-f]+\)"
+            r"(?: — (\d+) row\(s\) of post-checkpoint progress "
+            r"rolled back)?", e,
+        )
+        replays = [
+            int(r) for r in re.findall(
+                r"journal: replayed (\d+) row\(s\) from cursor \d+ "
+                r"after elastic", e,
+            )
+        ]
+        resets = e.count("journal: reset on rejoin")
+        if len(replays) + resets != len(resyncs):
+            failures.append(
+                f"host {uid}: {len(resyncs)} reform resync(s) but "
+                f"{len(replays)} journal replay(s) + {resets} rejoin "
+                f"reset(s) — a loss site stayed counted"
+            )
+            continue
+        discarded = sum(
+            int(r) for r in re.findall(
+                r"elastic rescue: discarded \d+ in-flight.*?"
+                r"\(~(\d+) row\(s\)\)", e,
+            )
+        )
+        # each resync's rolled-back rows are global; this host's share is
+        # 1/len(pre-reform members) (even synthetic shards, all-padding
+        # ticks excluded from counts). epochs[k] is the view REFORM k+1
+        # left — resync k's old view.
+        rolled_share = sum(
+            int(rolled or 0) // len(epochs[k][1])
+            for k, rolled in enumerate(resyncs)
+            if k < len(epochs)
+        )
+        if replays and sum(replays) != rolled_share + discarded:
+            failures.append(
+                f"host {uid}: replayed {sum(replays)} row(s) but the "
+                f"rescue threw away {rolled_share + discarded} "
+                f"(rolled share {rolled_share} + discarded {discarded}) "
+                f"— recovery is not replay-exact"
+            )
+        replayed_rows += sum(replays)
+
     pauses = sum(e.count("chaos: peer.pause stalling") for e in errs)
     return {
         "mode": "chaos-fleet",
@@ -258,6 +311,7 @@ def run_storm(
         "elections": len(winners),
         "winners": winners,
         "crc_rounds": crc_rounds,
+        "replayed_rows": replayed_rows,
         "pauses": pauses,
         "failures": failures,
         "ok": not failures,

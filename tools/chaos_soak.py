@@ -22,6 +22,15 @@ and RECOVER (no sentinel abort, no fetch abort), all three rules fire,
 and row losses show up in counters (rows_lost / rows_dropped_parse /
 rows_shed) — never silently.
 
+r21 adds a JOURNAL phase (--journalPhase, on by default): one
+poisoned-batch storm with the durable intake journal ON against a clean
+no-chaos control over the same corpus, pinned clock. The sentinel's
+rollback must land as a journal REPLAY — replayed rows > 0, zero rows
+lost, zero torn tails — and the storm's final weights must be BIT-EQUAL
+to the unfailed control's (crash-equals-clean, ISSUE 19). The source
+phase inherits the same contract: its rollbacks must replay, not count
+losses.
+
 r20 adds a FLEET phase (--fleetPhase, on by default): one lead-kill
 election storm through tools/chaos_fleet.py — ``--fleetHosts`` real
 lockstep worker processes, the launch lead hard-killed mid-run, the
@@ -36,7 +45,8 @@ diagnosable after the fact instead of being a dead stdout log.
 
 Usage: python tools/chaos_soak.py [--minutes M] [--tweets N] [--chaos SPEC]
           [--sourceChaos SPEC] [--sourcePhase on|off]
-          [--fleetPhase on|off] [--fleetHosts N] [--artifactDir DIR]
+          [--fleetPhase on|off] [--fleetHosts N] [--journalPhase on|off]
+          [--artifactDir DIR]
 Prints one JSON line at the end; exits non-zero on any violated invariant.
 """
 
@@ -76,6 +86,7 @@ def main(argv=None) -> None:
     minutes, n_tweets, chaos = 10.0, 16384, DEFAULT_CHAOS
     source_chaos, source_phase = DEFAULT_SOURCE_CHAOS, True
     fleet_phase, fleet_hosts = True, 2
+    journal_phase = True
     artifact_dir = ""
     i = 0
     while i < len(args):
@@ -91,6 +102,8 @@ def main(argv=None) -> None:
             source_phase = args[i + 1] == "on"; i += 2
         elif args[i] == "--fleetPhase":
             fleet_phase = args[i + 1] == "on"; i += 2
+        elif args[i] == "--journalPhase":
+            journal_phase = args[i + 1] == "on"; i += 2
         elif args[i] == "--fleetHosts":
             fleet_hosts = int(args[i + 1]); i += 2
         elif args[i] == "--artifactDir":
@@ -119,6 +132,15 @@ def main(argv=None) -> None:
         "--checkpointDir", os.path.join(tmp, "ck"), "--checkpointEvery", "4",
         "--lightning", closed, "--twtweb", closed,
         "--webTimeout", "0.5",
+        # the transport phase REUSES its checkpoint dir: each round
+        # restores the last round's counters and re-reads the whole file
+        # on top (the endurance ledger below checks per-round deltas).
+        # With the journal on, boot replay would correctly fast-forward
+        # past the fully-journaled corpus and train 0 rows — so this
+        # phase pins --journal off, which doubles as soak coverage for
+        # the off path under transport chaos (the journal's own contract
+        # has its dedicated phase)
+        "--journal", "off",
         "--chaos", chaos,
     ]
 
@@ -150,7 +172,6 @@ def main(argv=None) -> None:
             "--seconds", "0", "--batchBucket", "2048",
             "--tokenBucket", "512",
             "--maxQueueRows", str(4 * 2048),
-            "--checkpointDir", os.path.join(tmp, "ck-src"),
             "--checkpointEvery", "2",
             "--lightning", closed, "--twtweb", closed,
             "--webTimeout", "0.5",
@@ -158,22 +179,27 @@ def main(argv=None) -> None:
         ]
         deadline = time.time() + minutes * 60.0 * 0.5
         reg0 = _metrics.get_registry()
-        count0 = 0
         while time.time() < deadline:
+            # a FRESH checkpoint dir per round: the journal (on — the
+            # sentinel's replay conversion is this phase's invariant now)
+            # makes a reused dir an exact resume, which would correctly
+            # train 0 new rows on round 2 — each round stands alone
+            ck_src = os.path.join(tmp, f"ck-src-{src_rounds}")
             try:
-                totals = app.run(ConfArguments().parse(list(src_args)))
+                totals = app.run(ConfArguments().parse(
+                    src_args + ["--checkpointDir", ck_src]
+                ))
             except RuntimeError as exc:
                 failures.append(
                     f"source-chaos round {src_rounds + 1} aborted: {exc}"
                 )
                 break
             src_rounds += 1
-            if totals["count"] - count0 <= 0:
+            if totals["count"] <= 0:
                 failures.append(
                     f"source-chaos round {src_rounds} made no progress"
                 )
                 break
-            count0 = totals["count"]
         snap = reg0.snapshot()["counters"]
         src_rollbacks = snap.get("model.rollbacks", 0)
         if src_rounds:
@@ -184,14 +210,111 @@ def main(argv=None) -> None:
             for rule in ("source.nan", "source.garbage", "source.burst"):
                 if not snap.get(f"chaos.{rule}.injected", 0):
                     failures.append(f"{rule} never fired")
-            # losses must be ACCOUNTED, never silent: every poisoned batch
-            # shows in rows_lost, every garbled line in rows_dropped_parse
-            if not snap.get("model.rows_lost", 0):
-                failures.append("rollbacks fired but model.rows_lost is 0")
+            # the sentinel's rollback is a REPLAY site now (ISSUE 19: the
+            # intake journal is on — --checkpointDir implies --journal
+            # auto), so a fired rollback must show replayed rows and ZERO
+            # lost rows; garbled lines stay counted in rows_dropped_parse
+            if not snap.get("journal.replayed_rows", 0):
+                failures.append(
+                    "rollbacks fired but journal.replayed_rows is 0 — "
+                    "the rollback loss site stayed counted, not replayed"
+                )
+            if snap.get("model.rows_lost", 0):
+                failures.append(
+                    f"{snap['model.rows_lost']} row(s) lost to rollbacks "
+                    "with the journal ON — recovery is not replay-exact"
+                )
             if not snap.get("ingest.rows_dropped_parse", 0):
                 failures.append(
                     "garbage fired but ingest.rows_dropped_parse is 0"
                 )
+
+    # -- journal phase (r21, ISSUE 19): crash-equals-clean ---------------
+    # one poisoned-batch storm with the intake journal ON, against a
+    # clean no-chaos control over the same corpus: the sentinel rollback
+    # must convert into a journal replay (replayed rows > 0, ZERO rows
+    # lost), and the storm's final weights must be BIT-EQUAL to the
+    # control's — the whole crash-equals-clean contract in one
+    # differential. The clock seam is pinned for the phase (featurize
+    # freshness terms must match across the two runs).
+    jr = {}
+    if journal_phase and not failures:
+        import numpy as np
+
+        from twtml_tpu.checkpoint import Checkpointer
+
+        _faults.uninstall_chaos()
+        prior_now = os.environ.get("TWTML_NOW_MS")
+        os.environ["TWTML_NOW_MS"] = "1785320000000"
+        try:
+            def jr_args(ck, spec):
+                a = [
+                    "--source", "replay", "--replayFile", replay,
+                    "--seconds", "0", "--batchBucket", "2048",
+                    "--tokenBucket", "512",
+                    "--checkpointDir", os.path.join(tmp, ck),
+                    "--checkpointEvery", "2",
+                    "--lightning", closed, "--twtweb", closed,
+                    "--webTimeout", "0.5",
+                ]
+                return a + (["--chaos", spec] if spec else [])
+
+            before = _metrics.get_registry().snapshot()["counters"]
+            storm = app.run(ConfArguments().parse(
+                jr_args("ck-journal", "source.nan@6,seed=3")
+            ))
+            _faults.uninstall_chaos()
+            after = _metrics.get_registry().snapshot()["counters"]
+            clean = app.run(ConfArguments().parse(
+                jr_args("ck-journal-clean", "")
+            ))
+            jr = {
+                "replayed_rows": after.get("journal.replayed_rows", 0)
+                - before.get("journal.replayed_rows", 0),
+                "rows_lost": after.get("model.rows_lost", 0)
+                - before.get("model.rows_lost", 0),
+                "torn_tails": after.get("journal.torn_tails", 0)
+                - before.get("journal.torn_tails", 0),
+            }
+            if storm["count"] != n_tweets or clean["count"] != n_tweets:
+                failures.append(
+                    f"journal phase trained {storm['count']} (storm) / "
+                    f"{clean['count']} (control) of {n_tweets} tweets"
+                )
+            if not jr["replayed_rows"]:
+                failures.append(
+                    "journal phase: the poisoned batch never replayed"
+                )
+            if jr["rows_lost"]:
+                failures.append(
+                    f"journal phase: {jr['rows_lost']} row(s) lost — "
+                    "recovery is not replay-exact"
+                )
+            if jr["torn_tails"]:
+                failures.append(
+                    f"journal phase: {jr['torn_tails']} torn tail(s) on "
+                    "clean shutdown/reopen"
+                )
+            w_storm, m_storm = Checkpointer(
+                os.path.join(tmp, "ck-journal")
+            ).restore()
+            w_clean, m_clean = Checkpointer(
+                os.path.join(tmp, "ck-journal-clean")
+            ).restore()
+            jr["bit_equal"] = bool(
+                m_storm["count"] == m_clean["count"]
+                and np.array_equal(np.asarray(w_storm), np.asarray(w_clean))
+            )
+            if not jr["bit_equal"]:
+                failures.append(
+                    "journal phase: storm weights are not bit-equal to "
+                    "the unfailed control — crash-equals-clean violated"
+                )
+        finally:
+            if prior_now is None:
+                os.environ.pop("TWTML_NOW_MS", None)
+            else:
+                os.environ["TWTML_NOW_MS"] = prior_now
 
     # -- fleet phase (r20): lead-kill election storm, real processes -----
     # one storm, not time-budgeted (~90 s at 2 hosts): the launch lead is
@@ -255,6 +378,8 @@ def main(argv=None) -> None:
         "fleet_epochs": [m for _e, m in fleet_res["epochs"]]
         if fleet_res else [],
         "sentinel_rollbacks": src_rollbacks,
+        "journal": jr,
+        "journal_replayed_rows": counters.get("journal.replayed_rows", 0),
         "rows_lost": counters.get("model.rows_lost", 0),
         "rows_dropped_parse": counters.get("ingest.rows_dropped_parse", 0),
         "rows_shed": counters.get("ingest.rows_shed", 0),

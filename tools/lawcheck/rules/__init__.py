@@ -50,6 +50,7 @@ def all_rules() -> "list[Rule]":
     from .device import TW004Scatter
     from .docs import TW007FlagDocs
     from .host import TW005SilentSwallow, TW006WallClock
+    from .journal import TW009JournalSeam
     from .transport import TW001BackendInit, TW002FetchSeam, TW003ThreadPut
 
     return [
@@ -61,6 +62,7 @@ def all_rules() -> "list[Rule]":
         TW006WallClock(),
         TW007FlagDocs(),
         TW008WireArena(),
+        TW009JournalSeam(),
     ]
 
 

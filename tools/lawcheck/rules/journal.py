@@ -1,0 +1,64 @@
+"""Journal law: intake rows are journaled at ONE seam only.
+
+The durable intake journal (streaming/journal.py, ISSUE 19) is replay-exact
+ONLY because every row crosses exactly one append point — the post-parse,
+pre-featurize seam in streaming/context.py. A second append site would
+double-journal rows (replayed twice after a rollback → double-train), and an
+append *after* featurize would journal rows a crash between the seam and the
+step could lose. TW009 pins the seam the same way TW002 pins the fetch
+seams.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..findings import Finding
+from . import FileContext, Rule
+from .transport import dotted, import_aliases
+
+
+class TW009JournalSeam(Rule):
+    id = "TW009"
+    title = "journal append outside the blessed intake seam"
+    law = (
+        "the intake journal is replay-exact only if rows are appended at "
+        "exactly ONE seam (post-parse, pre-featurize: FeatureStream."
+        "_process and StreamingContext._run_batch_aligned call journal."
+        "record_intake); any other append site double-journals rows or "
+        "journals them at a point a crash can tear away from the trained "
+        "state (streaming/journal.py docstring; ISSUE 19)"
+    )
+    # the seam callers and the implementation itself
+    SEAM_FILES = frozenset({
+        "twtml_tpu/streaming/context.py",
+        "twtml_tpu/streaming/journal.py",
+    })
+
+    def check(self, ctx: FileContext):
+        if not ctx.path.startswith("twtml_tpu/"):
+            return []
+        if ctx.path in self.SEAM_FILES:
+            return []
+        aliases = import_aliases(ctx.tree)
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            path = dotted(node.func, aliases)
+            if path.endswith("record_intake"):
+                findings.append(Finding(
+                    self.id, ctx.path, node.lineno,
+                    "journal.record_intake outside the blessed intake seam "
+                    "— " + self.law,
+                ))
+            elif path.endswith(".append") and "journal" in path.lower():
+                # direct IntakeJournal.append through a journal-named handle
+                # (e.g. _journal.get().append(...)) — same law, no detour
+                # around the record_intake hook
+                findings.append(Finding(
+                    self.id, ctx.path, node.lineno,
+                    "direct journal .append() outside the blessed intake "
+                    "seam — " + self.law,
+                ))
+        return findings

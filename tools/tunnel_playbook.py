@@ -81,6 +81,11 @@ BACKLOG = (
      "r19 freshness plane on the real tunnel: the <=3% overhead gate in "
      "the regime where delivered-batch host costs bind (BENCHMARKS "
      "'Freshness plane overhead')"),
+    ("journal", ["tools/bench_journal.py", "--budget", "300"], 1200,
+     "r21 intake journal on the real tunnel: the CPU 0.981x paired "
+     "ratio co-schedules the append with the device step on one core; "
+     "under live upload RTT the append should hide entirely "
+     "(BENCHMARKS 'Durable intake journal')"),
     ("soak", ["tools/soak.py", "--minutes", "20",
               "--maxRssSlopeMbPerMin", "10"], 1800,
      "the axon RSS retention under the arena (r17): slope gate proves "

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from ..models.linear import StreamingLinearRegressionWithSGD
 from ..streaming import faults as _faults
+from ..streaming import journal as _journal
 from ..streaming.sources import ReplayFileSource, Source, SyntheticSource
 from ..telemetry import blackbox as _blackbox
 from ..telemetry import freshness as _freshness
@@ -287,6 +288,48 @@ def install_blackbox(conf) -> None:
         config=cfg, out_dir=out_dir, process_index=jax.process_index()
     )
     _blackbox.install_signal_handler()
+
+
+def install_journal(conf) -> None:
+    """``--journal`` wiring shared by the FeatureStream entry points
+    (linear/logistic; the k-means raw path has no featurize seam to
+    journal at): open this host's durable intake journal
+    (streaming/journal.py) so the seam in streaming/context.py appends.
+    Per-host directories under ``--checkpointDir`` — the journal records
+    THIS host's post-shard intake, keyed by the elastic uid (stable across
+    epochs and restarts) or the launch process id, so a restarted host
+    finds its own records. Call after ``init_distributed`` (needs the
+    process identity) and before the StreamingContext is built."""
+    if not conf.effective_journal():
+        # a journal left installed by an earlier run() in the same process
+        # (tests, embedded uses) would journal THIS run's seam too and
+        # leak its committed-cursor pairing — --journal off must be
+        # bit-exact pre-journal behavior
+        _journal.uninstall()
+        return
+    if not getattr(conf, "checkpointDir", ""):
+        raise SystemExit(
+            "--journal on requires --checkpointDir: the replay cursor "
+            "lives in verified checkpoint meta (use --journal auto to "
+            "follow the checkpoint flag)"
+        )
+    import os as _os
+
+    from ..parallel.elastic import get_runtime as _get_elastic_runtime
+
+    runtime = _get_elastic_runtime()
+    if runtime is not None:
+        suffix = f"-u{runtime.uid}"
+    else:
+        import jax
+
+        suffix = (
+            f"-p{jax.process_index()}" if jax.process_count() > 1 else ""
+        )
+    _journal.install(
+        _os.path.join(conf.checkpointDir, f"journal{suffix}"),
+        max_mb=int(getattr(conf, "journalMaxMb", 512) or 512),
+    )
 
 
 def build_source(
@@ -659,6 +702,7 @@ class AppCheckpoint:
         self._lead = runtime.is_lead if self._elastic else lead
         self._shadow = self._elastic and not self._lead
         self.every = int(getattr(conf, "checkpointEvery", 0) or 0)
+        self.restored_meta = None
         if not conf.checkpointDir:
             self._last = 0
             return
@@ -678,6 +722,11 @@ class AppCheckpoint:
             )
         self._ckpt = Checkpointer(ckpt_dir)
         restored = self._ckpt.restore()
+        # this host's OWN restored meta (followers restore their shadow
+        # archives): the intake journal's boot replay reads its cursor
+        # stamp from here (journal_boot_replay) — per-host, never the
+        # broadcast (each host replays its own journal)
+        self.restored_meta = restored[1] if restored is not None else None
         if restored is not None:
             state, meta = restored
             set_state(state)
@@ -729,6 +778,19 @@ class AppCheckpoint:
         if not self._lead and not self._shadow:
             self._last = totals["batches"]  # keep cadence bookkeeping aligned
             return
+        j = _journal.get()
+        if j is not None and not j.save_allowed:
+            # mid-replay: the weights already re-trained past the rollback
+            # cursor, but the committed cursor cannot advance until the
+            # final replayed batch delivers — a save now would stamp a
+            # cursor whose replay double-trains on crash-restore. Defer;
+            # _last stays put so the cadence retries next boundary.
+            log.info(
+                "checkpoint save deferred at batch %s: journal replay "
+                "still draining (retries next boundary)",
+                totals["batches"],
+            )
+            return
         meta = {"count": totals["count"], "batches": totals["batches"]}
         # quality stamp (ISSUE 8): every verified checkpoint records the
         # model-health picture at save time — the promotion-gate substrate
@@ -741,8 +803,22 @@ class AppCheckpoint:
         fresh = _freshness.snapshot_for_checkpoint()
         if fresh is not None:
             meta["freshness"] = fresh
+        # journal cursor stamp (ISSUE 19): saves run at weight-current
+        # boundaries on the thread that featurizes, so every record with
+        # id < cursor is inside the state being saved — the replay-exact
+        # resume point for rollback/resync/restart (streaming/journal.py)
+        jstamp = _journal.snapshot_for_checkpoint()
+        if jstamp is not None:
+            meta["journal"] = jstamp
         self._ckpt.save(totals["batches"], self._get_state(), meta)
         self._last = totals["batches"]
+        if jstamp is not None:
+            # bounded disk: segments retire once covered by EVERY retained
+            # verified archive (a fallback restore can land on the oldest)
+            oldest = self._ckpt.oldest_meta()
+            covered = ((oldest or {}).get("journal") or {}).get("cursor")
+            if covered is not None:
+                _journal.get().retire_covered(int(covered))
         # sticky flight-recorder context: a post-mortem bundle names the
         # checkpoint a restart will resume from (telemetry/blackbox.py)
         _blackbox.note(
@@ -769,6 +845,32 @@ class AppCheckpoint:
             return False
         self._save(totals)
         return True
+
+    def own_journal_stamp(self, batches: int) -> "dict | None":
+        """This host's journal cursor for the agreed rollback point: the
+        newest LOCAL archive's stamp, valid only when its ``batches``
+        matches the lead-agreed value (cadence saves are psum-aligned, so
+        lead and shadow archives land on the same batch indices; a stale
+        or missing local archive — fresh joiner, pre-journal history —
+        returns None and the caller falls back to counted loss). Local
+        disk read only: zero added fetches, zero added collectives."""
+        if self._ckpt is None:
+            return None
+        meta = self._ckpt.latest_meta()
+        if meta is None or int(meta.get("batches", -1)) != int(batches):
+            return None
+        return meta.get("journal")
+
+    def adopt_replay_totals(self, totals: dict, count, batches) -> None:
+        """Reset the run counters to a rollback point whose rows a journal
+        replay is about to re-ingest: the replayed rows re-count through
+        the unchanged handler path, so the final ledger matches an
+        unfailed run (the crash-equals-clean differential). Keeps the
+        cadence bookkeeping aligned so post-replay saves fire on the same
+        boundaries as a clean run."""
+        totals["count"] = int(count)
+        totals["batches"] = int(batches)
+        self._last = totals["batches"]
 
     def promote(self) -> None:
         """Elastic lead handoff: this host won an election. Its standby
@@ -896,17 +998,175 @@ class AppCheckpoint:
         # may be a collective (MultiHostSGDModel.latest_weights allgathers)
         # — the lead must participate too, then its disk state wins
         state = self._get_state()
+        count = batches = 0
         if self._lead and restored is not None:
             state = restored[0]
+            count = int(restored[1].get("count", 0))
+            batches = int(restored[1].get("batches", 0))
+        # the flags carry the agreed (count, batches) rollback point on the
+        # SAME broadcast — a follower needs it to locate its OWN journal
+        # cursor for replay (own_journal_stamp); zero added collectives
         flag, state = multihost_utils.broadcast_one_to_all((
-            np.array([ok], np.int64), state,
+            np.array([ok, count, batches], np.int64), state,
         ), is_source=bool(self._lead))
         if not int(flag[0]):
             return None
         self._set_state(jax.tree_util.tree_map(np.asarray, state))
         if self._lead and restored is not None:
             return restored[1]
-        return {"broadcast": True}
+        return {
+            "broadcast": True,
+            "count": int(flag[1]),
+            "batches": int(flag[2]),
+        }
+
+
+def journal_replay_rollback(ssc, ckpt: AppCheckpoint, totals: dict, meta,
+                            where: str) -> "int | None":
+    """Re-ingest every journaled row after the rollback point ``meta``
+    names — the conversion of a counted-loss site into a replay-exact one
+    (ISSUE 19). Returns rows replayed (0 when the cursor was already at
+    the tail), or None when replay was impossible (journal off, or no
+    local cursor for the agreed point) — the caller keeps its counted-loss
+    accounting then.
+
+    ``meta`` is the rollback target's checkpoint meta: a full local meta
+    (single-host / lead), a broadcast stub carrying (count, batches) (a
+    follower locates its OWN cursor via ``own_journal_stamp``), or None —
+    no verified checkpoint existed, the model was reset to initial zeros,
+    and the WHOLE journal replays from cursor 0 (crash-equals-clean holds
+    even before the first save).
+
+    Host-side only: disk reads + queue putbacks at the FRONT (row order
+    preserved; the replayed rows re-cross the unchanged featurize path
+    under append suppression). Multi-host replay rides the existing
+    lockstep cadence — a host with fewer replayed rows dispatches
+    all-padding ticks per the lockstep invariant; ZERO new collectives,
+    zero added fetches."""
+    j = _journal.get()
+    if j is None:
+        return None
+    if meta is None:
+        count = batches = 0
+        stamp = {"cursor": 0, "rows": 0}
+    else:
+        count = int(meta.get("count", 0))
+        batches = int(meta.get("batches", 0))
+        stamp = meta.get("journal")
+        if stamp is None:
+            stamp = ckpt.own_journal_stamp(batches)
+    if stamp is None:
+        log.warning(
+            "journal: no local cursor for the agreed rollback point "
+            "(batches=%d) after %s — rows stay counted as lost, not "
+            "replayed (stale/missing local archive or pre-journal "
+            "history)", batches if meta is not None else -1, where,
+        )
+        return None
+    cursor = int(stamp["cursor"])
+    # an EARLIER replay still draining (a storm re-poisons a replayed row,
+    # or a reform lands mid-drain) is superseded by this one — its cursor
+    # is at or below the old one, so its items re-cover the stale rows
+    # still parked at the queue front. Remove them before the new putback
+    # or the overlap trains twice.
+    stale = j.cancel_pending_replay()
+    if stale:
+        queued = ssc._drain(0)
+        qrows = sum(getattr(s, "rows", 1) for s in queued)
+        keep = (
+            _journal.IntakeJournal._split_items(queued, stale)
+            if qrows > stale else []
+        )
+        ssc._putback(keep)
+        log.warning(
+            "journal: superseded an in-progress replay — dropped %d stale "
+            "queued row(s) the new replay from cursor %d re-covers",
+            min(stale, qrows), cursor,
+        )
+    items, rows = j.replay_from(cursor)
+    ssc._putback(items)
+    ckpt.adopt_replay_totals(totals, count, batches)
+    _blackbox.record(
+        "journal_replay", where=where, rows=rows, cursor=cursor,
+        count=count, batches=batches,
+    )
+    log.warning(
+        "journal: replayed %d row(s) from cursor %d after %s — counters "
+        "reset to (count=%d, batches=%d); recovery is replay-exact, zero "
+        "rows lost", rows, cursor, where, count, batches,
+    )
+    return rows
+
+
+def journal_boot_replay(conf, ssc, ckpt: AppCheckpoint, totals: dict) -> int:
+    """Boot half of journal recovery (watchdog-abort restart, kill -9,
+    recycle): every row this host ever journaled is either inside the
+    restored checkpoint (id < cursor) or re-enqueued here from the journal
+    (id >= cursor), and the deterministic source fast-forwards past ALL of
+    them (``SkipRowsSource``) instead of re-producing from the top. Call
+    after ``AppCheckpoint`` restores and before the stream starts."""
+    j = _journal.get()
+    if j is None:
+        return 0
+    from ..parallel import elastic as _elastic
+
+    rt = _elastic.get_runtime()
+    if rt is not None and rt.joined_late:
+        # this host's pre-departure coverage moved to its adopters when
+        # the fleet reformed without it — replaying (or fast-forwarding
+        # past) its old journal would double-train adopted rows
+        j.reset()
+        log.warning(
+            "journal: reset on late join — this host's pre-departure "
+            "rows belong to their adopters now; boot replay skipped"
+        )
+        return 0
+    meta = getattr(ckpt, "restored_meta", None)
+    stamp = (meta or {}).get("journal")
+    if meta is not None and stamp is None:
+        log.warning(
+            "journal: the restored checkpoint carries no journal cursor "
+            "(pre-journal archive) — boot replay skipped; the source "
+            "re-produces from its top as a bare checkpoint-restart would"
+        )
+        return 0
+    if meta is not None and int(meta.get("batches", -1)) != int(
+        totals.get("batches", 0)
+    ):
+        # multi-host: the lead's broadcast moved the counters away from
+        # this host's own archive — its cursor no longer names the
+        # adopted state, so an exact replay is off the table
+        log.warning(
+            "journal: local archive (batches=%s) disagrees with the "
+            "adopted counters (batches=%s) — boot replay skipped",
+            meta.get("batches"), totals.get("batches"),
+        )
+        return 0
+    cursor = int(stamp["cursor"]) if stamp is not None else 0
+    skip_rows = j.rows_total
+    items, rows = j.replay_from(cursor)
+    ssc._putback(items)
+    # fast-forward only sources that RE-PRODUCE the same rows on restart
+    # (replay file, seeded synthetic) — a live stream never re-produces,
+    # so skipping would drop fresh rows, not duplicates
+    fast_forward = skip_rows if conf.source != "twitter" else 0
+    if fast_forward:
+        from ..streaming.sources import SkipRowsSource
+
+        ssc._source = SkipRowsSource(ssc._source, fast_forward)
+    _blackbox.record(
+        "journal_replay", where="boot", rows=rows, cursor=cursor,
+        count=int(totals.get("count", 0)),
+        batches=int(totals.get("batches", 0)),
+    )
+    if skip_rows or rows:
+        log.warning(
+            "journal: boot resume — %d journaled row(s), %d fast-forwarded "
+            "at the source (%d inside the restored checkpoint, %d replayed "
+            "from cursor %d); zero rows lost, zero rows double-trained",
+            skip_rows, fast_forward, skip_rows - rows, rows, cursor,
+        )
+    return rows
 
 
 class DivergenceSentinel:
@@ -953,7 +1213,7 @@ class DivergenceSentinel:
     it."""
 
     def __init__(self, conf, model, ckpt: AppCheckpoint, ssc,
-                 lead: bool = True):
+                 lead: bool = True, totals: "dict | None" = None):
         self.enabled = getattr(conf, "sentinel", "on") == "on"
         self.max_rollbacks = int(getattr(conf, "sentinelRollbacks", 3) or 0)
         self.window = max(1, int(getattr(conf, "sentinelWindow", 512) or 1))
@@ -961,6 +1221,14 @@ class DivergenceSentinel:
         self._ckpt = ckpt
         self._ssc = ssc
         self._lead = lead
+        # run counters, for the journal-replay conversion (ISSUE 19): a
+        # replayed rollback resets them to the checkpoint so the re-counted
+        # rows end at the clean-run ledger; None (legacy callers) keeps
+        # the counted-loss path
+        self._totals = totals
+        # rows of the current episode replayed (vs counted lost): set per
+        # rollback by _rollback, read by admit's loss accounting
+        self._replaying = False
         self._num_features = int(getattr(conf, "numTextFeatures", 1000))
         self._tainted = False
         self._delivered = 0
@@ -1015,19 +1283,26 @@ class DivergenceSentinel:
             return True
         self._nonfinite_count.inc()
         rows = int(out.count) if hasattr(out, "count") else 0
-        self._rows_lost.inc(rows)
         if self._pipeline is not None:
             self._pipeline.refund_dispatch()
         if self._tainted:
             # same episode: a batch dispatched against the poisoned
             # weights before the rollback took effect drains through
+            if not self._replaying:
+                self._rows_lost.inc(rows)
             log.warning(
                 "divergence sentinel: skipping tainted in-flight batch "
-                "(delivered %d, %d rows)", self._delivered, rows,
+                "(delivered %d, %d rows)%s", self._delivered, rows,
+                " — rows re-ingest via journal replay"
+                if self._replaying else "",
             )
             return False
         self._tainted = True
         self._rollback()
+        if not self._replaying:
+            # no journal (or no usable cursor): the skipped rows are lost,
+            # counted — the pre-journal ledger
+            self._rows_lost.inc(rows)
         return False
 
     def _rollback(self) -> None:
@@ -1042,6 +1317,17 @@ class DivergenceSentinel:
             episode=len(self._rollback_points),
         )
         meta = self._ckpt.rollback_to_verified()
+        # journal-replay conversion (ISSUE 19): re-ingest every row after
+        # the rollback point instead of skipping it — the sentinel site's
+        # half of the crash-equals-clean differential. Legacy callers
+        # (no totals) and --journal off keep the counted-loss behavior.
+        replayed = None
+        if self._totals is not None:
+            replayed = journal_replay_rollback(
+                self._ssc, self._ckpt, self._totals, meta,
+                where="sentinel rollback",
+            )
+        self._replaying = replayed is not None
         if meta is not None:
             log.error(
                 "divergence sentinel: NON-FINITE model state at delivered "
@@ -2425,7 +2711,48 @@ def attach_elastic(conf, ssc, model, stream, ckpt, totals):
                     "elastic: dropped %d stale queued row(s) on rejoin "
                     "(counted in elastic.rows_dropped_rejoin)", dropped,
                 )
+        pre_resync = (int(totals["count"]), int(totals["batches"]))
         ckpt.resync_from_verified(totals)
+        # journal-replay conversion (ISSUE 19): after the fleet converges
+        # on the lead-agreed rollback point, every host re-ingests ITS OWN
+        # journaled rows past its cursor — the in-flight rows a rescue
+        # discarded (drain_discard) and the post-checkpoint rows the
+        # resync rolled back. Replay rides the lockstep cadence (dry hosts
+        # dispatch all-padding); ZERO new collectives. A REJOINER instead
+        # resets its journal: its pre-departure coverage moved to the
+        # adopters (_rebalance_intake), so replaying it would double-train.
+        if _journal.get() is not None:
+            # the reform discarded the fetch pipeline's in-flight
+            # deliveries wholesale (drain_discard above): their dispatch
+            # tokens would strand and desync every later pairing — drop
+            # them; the replay below re-covers their rows
+            _journal.get().clear_inflight()
+            rejoined = set(plan["members"]) - set(st["old_members"])
+            if runtime.uid in rejoined:
+                _journal.get().reset()
+                log.warning(
+                    "journal: reset on rejoin — this host's pre-departure "
+                    "rows belong to their adopters now"
+                )
+            else:
+                stub = {
+                    "count": totals["count"], "batches": totals["batches"],
+                }
+                if (totals["count"], totals["batches"]) == pre_resync:
+                    # nothing rolled back: the resync adopted weights that
+                    # cover exactly the delivered batches (the lead's live
+                    # weights when no verified checkpoint exists yet, or a
+                    # clean-commit save at the current boundary). This
+                    # host's COMMITTED delivery cursor is that same point
+                    # — no archive lookup needed, so the first reform can
+                    # precede the first save and still replay the
+                    # discarded in-flight rows instead of counting them.
+                    stub["journal"] = (
+                        _journal.get().snapshot_for_checkpoint()
+                    )
+                journal_replay_rollback(
+                    ssc, ckpt, totals, stub, where=f"elastic {reason}",
+                )
         _rebalance_intake(
             source, st["old_members"], plan["members"], runtime.uid, reason,
         )
@@ -2506,6 +2833,15 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
 
     def handle(out, batch, t, at_boundary=True):  # noqa: F811
         watchdog.tick()
+        # journal committed-cursor advance (ISSUE 19): the INNERMOST
+        # wrapper — only batches every admission filter accepted (no
+        # sentinel skip, no globally-empty no-op) reach here, so the
+        # popped dispatch token is safe to commit. BEFORE the app handler:
+        # a checkpoint save inside this very delivery must stamp a cursor
+        # that covers this batch.
+        _j = _journal.get()
+        if _j is not None:
+            _j.note_delivered()
         guarded_handle(out, batch, t, at_boundary=at_boundary)
 
     if sentinel is not None and sentinel.enabled:
@@ -2599,6 +2935,9 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
             if batch.num_valid == 0:
                 log.debug("batch: 0")
                 _lineage.drop_newest()  # the shed batch never dispatches
+                _js = _journal.get()
+                if _js is not None:
+                    _js.drop_newest()  # un-push its dispatch token too
                 return
             fn(batch, t)
 
@@ -2635,6 +2974,21 @@ def attach_super_batcher(conf, stream, model, handle, stop_requested=None,
         def handle(out, batch, t, at_boundary=True):  # noqa: F811
             freshness.observe(out, at_boundary=at_boundary)
             fresh_inner(out, batch, t, at_boundary=at_boundary)
+
+    if _journal.get() is not None:
+        # journal dispatch-token pop (ISSUE 19): the OUTERMOST delivery
+        # wrapper — every delivered batch, including ones the sentinel
+        # skips or the multihost filter drops as globally empty, must pop
+        # its token in dispatch order or the committed-cursor pairing
+        # desynchronizes (the commit itself happens in the innermost
+        # wrapper above, so filtered batches pop without committing)
+        journal_pop_inner = handle
+
+        def handle(out, batch, t, at_boundary=True):  # noqa: F811
+            _jp = _journal.get()
+            if _jp is not None:
+                _jp.pop_dispatch()
+            journal_pop_inner(out, batch, t, at_boundary=at_boundary)
 
     # cadence drains exist for checkpoint saves only: without a
     # checkpointDir each drain would stall the fetch pipelining for a

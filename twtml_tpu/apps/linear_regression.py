@@ -34,6 +34,7 @@ from .common import (  # noqa: F401
     init_distributed,
     install_blackbox,
     install_chaos,
+    install_journal,
     install_trace,
     select_backend,
     warmup_compile,
@@ -63,6 +64,9 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     # crash flight recorder: every abort path dumps a post-mortem bundle
     # next to the checkpoint dir (apps/common.install_blackbox)
     install_blackbox(conf)
+    # durable intake journal (--journal, auto-on with --checkpointDir):
+    # every recovery path below replays from it instead of counting loss
+    install_journal(conf)
 
     log.info("Initializing streaming context... %s sec/batch", conf.seconds)
     ssc = StreamingContext(
@@ -96,6 +100,13 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         lead=lead,
     )
 
+    # journal boot recovery (kill -9 / watchdog-abort restart): replay the
+    # rows past the restored checkpoint's cursor and fast-forward the
+    # source past everything journaled — resume is replay-exact
+    from .common import journal_boot_replay
+
+    journal_boot_replay(conf, ssc, ckpt, totals)
+
     # --recycleAfterMb: bounded process lifetime (checkpoint + exact-resume
     # re-exec) once RSS crosses the ceiling — the actionable form of the
     # RSS watchdog's diagnosis (apps/common.ProcessRecycler)
@@ -106,7 +117,9 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     # checkpoint, abort cleanly after N rollbacks (apps/common)
     from .common import DivergenceSentinel, ModelWatchGuard
 
-    sentinel = DivergenceSentinel(conf, model, ckpt, ssc, lead=lead)
+    sentinel = DivergenceSentinel(
+        conf, model, ckpt, ssc, lead=lead, totals=totals
+    )
 
     # model watch (--modelWatch, default on): drift/loss-trend telemetry
     # from the in-step quality vector riding the existing stats fetch;
@@ -204,6 +217,12 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
 
         pipeline_trace.uninstall()  # flush + close the --trace file
         ckpt.final_save(totals)
+        from ..streaming import journal as _journal_mod
+
+        # after the final save (it stamps the journal cursor): close the
+        # segment files and clear the module face so a later run() in the
+        # same process starts clean
+        _journal_mod.uninstall()
     if ssc.failed:
         # elastic runs leave via a hard exit either way (abandoned-epoch
         # teardown during interpreter finalization is unsafe)

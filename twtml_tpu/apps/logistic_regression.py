@@ -38,7 +38,9 @@ from .common import (
     init_distributed,
     install_blackbox,
     install_chaos,
+    install_journal,
     install_trace,
+    journal_boot_replay,
     select_backend,
     warmup_compile,
 )
@@ -64,6 +66,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     install_trace(conf)
     install_chaos(conf)
     install_blackbox(conf)  # crash flight recorder (apps/common)
+    install_journal(conf)  # durable intake journal (--journal, apps/common)
 
     ssc = StreamingContext(
         batch_interval=conf.seconds,
@@ -92,10 +95,15 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         totals=totals,
         lead=lead,
     )
+    # journal boot recovery — same replay-exact resume as the flagship app
+    journal_boot_replay(conf, ssc, ckpt, totals)
+
     recycler = ProcessRecycler(conf, ckpt, totals)
 
     # divergence sentinel — same guard as the flagship app (apps/common)
-    sentinel = DivergenceSentinel(conf, model, ckpt, ssc, lead=lead)
+    sentinel = DivergenceSentinel(
+        conf, model, ckpt, ssc, lead=lead, totals=totals
+    )
 
     # model watch — same drift/trend plane as the flagship app
     from .common import ModelWatchGuard
@@ -174,6 +182,12 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
 
         pipeline_trace.uninstall()  # flush + close the --trace file
         ckpt.final_save(totals)
+        from ..streaming import journal as _journal_mod
+
+        # after the final save (it stamps the journal cursor): close the
+        # segment files and clear the module face so a later run() in the
+        # same process starts clean
+        _journal_mod.uninstall()
     if ssc.failed:
         elastic_exit(failed=True)
         raise RuntimeError(

@@ -178,6 +178,36 @@ class Checkpointer:
             return None
         return int(_CKPT_RE.match(names[-1]).group(1))
 
+    def _read_meta(self, name: str) -> dict | None:
+        """Meta sidecar of one archive, arrays untouched (np.load is lazy
+        per entry, so this reads a few KB, not the weights)."""
+        try:
+            with np.load(os.path.join(self.directory, name)) as data:
+                return json.loads(bytes(data["__meta__"]).decode("utf-8"))
+        except Exception:
+            log.warning("unreadable checkpoint meta in %s; skipped", name)
+            return None
+
+    def latest_meta(self) -> dict | None:
+        """Newest readable archive's meta (no state load, no verification)
+        — the intake journal reads its local replay cursor from here when
+        a broadcast rollback names only (count, batches)."""
+        for name in reversed(self._checkpoints()):
+            meta = self._read_meta(name)
+            if meta is not None:
+                return meta
+        return None
+
+    def oldest_meta(self) -> dict | None:
+        """Oldest RETAINED archive's meta — journal segments retire only
+        once covered by every checkpoint a fallback restore could land on,
+        so retirement keys on the oldest cursor still on disk."""
+        for name in self._checkpoints():
+            meta = self._read_meta(name)
+            if meta is not None:
+                return meta
+        return None
+
     @staticmethod
     def _verify(path: str, meta: dict, arrays: "dict[str, np.ndarray]") -> bool:
         """Integrity + finiteness gate for one loaded archive; False means

@@ -142,6 +142,16 @@ class ConfArguments:
         self.dtype: str = conf.get("dtype", "float32")
         self.checkpointDir: str = conf.get("checkpointDir", "")
         self.checkpointEvery: int = int(conf.get("checkpointEvery", "0"))
+        self.journal: str = conf.get("journal", "auto")
+        if self.journal not in ("auto", "on", "off"):
+            raise ValueError(
+                f"journal must be 'auto', 'on' or 'off', got {self.journal!r}"
+            )
+        self.journalMaxMb: int = int(conf.get("journalMaxMb", "512"))
+        if self.journalMaxMb <= 0:
+            raise ValueError(
+                f"journalMaxMb must be positive, got {self.journalMaxMb}"
+            )
         self.profileDir: str = conf.get("profileDir", "")
         self.trace: str = conf.get("trace", "")
         self.traceMaxMb: int = int(conf.get("traceMaxMb", "256"))
@@ -377,6 +387,17 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
   --dtype <float32|bfloat16|float64>           Device dtype. Default: {self.dtype}
   --checkpointDir <path>                       Enable model checkpoint/resume
   --checkpointEvery <int batches>              Checkpoint cadence. Default: {self.checkpointEvery}
+  --journal <auto|on|off>                      Durable intake journal (streaming/journal.py):
+                                               CRC-framed raw-row records at the intake seam
+                                               make sentinel rollback, elastic resync and
+                                               restart REPLAY rows instead of counting them
+                                               lost; auto = on iff --checkpointDir is set
+                                               (verified checkpoints carry the replay
+                                               cursor). Default: {self.journal}
+  --journalMaxMb <int MB>                      Journal disk ceiling; segments retire once a
+                                               verified checkpoint covers them, and the
+                                               oldest are dropped (loudly, counted) past
+                                               this cap. Default: {self.journalMaxMb}
   --profileDir <path>                          Enable jax.profiler traces
   --trace <path.trace>                         Write a Chrome-trace-event pipeline trace
                                                (Perfetto-loadable): per-batch stage spans
@@ -720,6 +741,14 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
             self.checkpointDir = take()
         elif flag == "--checkpointEvery":
             self.checkpointEvery = int(take())
+        elif flag == "--journal":
+            self.journal = take()
+            if self.journal not in ("auto", "on", "off"):
+                self.printUsage(1)
+        elif flag == "--journalMaxMb":
+            self.journalMaxMb = int(take())
+            if self.journalMaxMb <= 0:
+                self.printUsage(1)
         elif flag == "--profileDir":
             self.profileDir = take()
         elif flag == "--trace":
@@ -928,6 +957,20 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                 "--seconds 0)"
             )
         return "dict"
+
+    def effective_journal(self) -> bool:
+        """Resolve ``--journal auto`` (the default): the durable intake
+        journal is ON exactly when ``--checkpointDir`` is set — the replay
+        cursor lives in verified checkpoint meta, so without checkpoints
+        there is nothing exact to resume from (and the flag's whole point
+        is the crash-equals-clean differential, tests/test_journal.py).
+        Explicit ``on``/``off`` wins; explicit ``on`` without a checkpoint
+        directory is rejected at install (apps/common.install_journal) —
+        the journal needs a directory and a cursor authority. ``off`` is
+        bit-exact pre-journal behavior: every hook no-ops."""
+        if self.journal != "auto":
+            return self.journal == "on"
+        return bool(self.checkpointDir)
 
     def effective_max_queue_rows(self) -> int:
         """Resolve ``--maxQueueRows``: explicit > 0 wins; 0 (the default)

@@ -35,6 +35,7 @@ from ..telemetry import sideband as _sideband
 from ..telemetry import trace as _trace
 from ..utils import get_logger
 from ..utils.clock import now_s
+from . import journal as _journal
 from .sources import Source
 
 log = get_logger("streaming.context")
@@ -456,6 +457,11 @@ class FeatureStream(RawStream):
         # featurize — the event-time span + a stage-clock snapshot; no-op
         # unless the plane is on
         _lineage.open_batch(statuses)
+        # durable intake journal (r21): the ONE blessed append seam with
+        # _run_batch_aligned below (lawcheck TW009) — raw rows become a
+        # CRC-framed replay record BEFORE featurize, so every recovery
+        # path re-ingests bytes the unchanged featurize path re-reads
+        _journal.record_intake(statuses)
         batch = self._featurize(statuses)
         self._check_buckets(batch)
         self._record_metrics(batch)
@@ -682,6 +688,9 @@ class StreamingContext:
         # before featurize like FeatureStream._process (the failure paths
         # below re-featurize but never re-open)
         _lineage.open_batch(statuses)
+        # intake journal (r21): append ONCE per lockstep batch — the
+        # failure paths below re-featurize but never re-append
+        _journal.record_intake(statuses)
         try:
             batch = stream._featurize(statuses)
         except Exception:

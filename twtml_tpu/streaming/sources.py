@@ -742,6 +742,56 @@ class IdShardedSource(Source):
         return self.inner._backoff(exc, restarts)
 
 
+class SkipRowsSource(Source):
+    """Discard the first ``skip_rows`` ROWS of an inner source — the boot
+    half of journal replay recovery (apps/common.journal_boot_replay): on a
+    restart, every row this host ever journaled is either inside the
+    restored checkpoint (id < cursor) or re-enqueued from the journal
+    (id >= cursor), so the deterministic source must fast-forward past ALL
+    of them instead of re-producing from the top (which is what a bare
+    checkpoint-restart of a replay file does — re-trained rows). A
+    ParsedBlock item counts its rows and is SPLIT at the skip boundary
+    (features/blocks.slice_block), matching the journal's row arithmetic.
+
+    Wraps the OUTERMOST (post-shard) source: the journal records this
+    host's post-shard stream, so the skip count is in the same row space.
+    Exposes ``.inner`` for the elastic residue-rebalance chain walk."""
+
+    name = "skiprows"
+
+    def __init__(self, inner: Source, skip_rows: int, **kw):
+        kw.setdefault("max_restarts", inner.max_restarts)
+        kw.setdefault("restart_backoff", inner.restart_backoff)
+        super().__init__(**kw)
+        self.inner = inner
+        self.skip_rows = int(skip_rows)
+
+    def produce(self) -> Iterator[Status]:
+        # a supervised restart re-enters produce(): the inner replay source
+        # re-produces from its top, so the skip re-applies from its top too
+        remaining = self.skip_rows
+        for item in self.inner.produce():
+            if remaining > 0:
+                take = getattr(item, "rows", None)
+                if take is None:
+                    remaining -= 1
+                    continue
+                if take <= remaining:
+                    remaining -= take
+                    continue
+                from ..features.blocks import slice_block
+
+                cut = remaining
+                remaining = 0
+                item = slice_block(item, cut, take)
+                if item.rows == 0:
+                    continue
+            yield item
+
+    def _backoff(self, exc: Exception, restarts: int) -> float:
+        return self.inner._backoff(exc, restarts)
+
+
 class MultiSource(Source):
     """Sharded receiver fan-in: run N inner sources concurrently into one
     stream. The reference is hard-wired to a single Twitter4j receiver

@@ -80,6 +80,11 @@
     const rb = document.getElementById("rollbacks");
     rb.textContent = String(counters["model.rollbacks"] || 0);
     rb.classList.toggle("degraded", (counters["model.rollbacks"] || 0) > 0);
+    // durable intake journal: rows re-ingested by replay recovery (the
+    // crash-equals-clean counter — nonzero means a recovery replayed
+    // instead of counting rows lost)
+    document.getElementById("journalReplayed").textContent =
+      String(counters["journal.replayed_rows"] || 0);
     // derived latency quantiles (Histogram.snapshot p95, seconds → ms)
     const hist = (json.histograms || {})["fetch.latency_s"] || {};
     document.getElementById("fetchP95").textContent =
