@@ -292,6 +292,17 @@ def main() -> None:
         # the compressed-wire record (see the "wire" child above; full
         # paired harness: tools/bench_wirecodec.py)
         record["wire"] = wire_result
+    # run provenance (ISSUE 20): the monotonic per-host run id and the
+    # operating-point fingerprint join this line to the telemetry
+    # historian's segments and the round tables in BENCHMARKS.md
+    from twtml_tpu.utils.runid import config_fingerprint, next_run_id
+
+    record["run_id"] = next_run_id()
+    record["config_fingerprint"] = config_fingerprint({
+        "bench": "headline", "n_tweets": N_TWEETS, "batch": BATCH,
+        "time_budget_s": TIME_BUDGET_S,
+        "tenants": os.environ.get("TWTML_BENCH_TENANTS", "1"),
+    })
     print(json.dumps(record))
 
 

@@ -688,6 +688,72 @@ def test_freshness_backfill_fetched_on_boot():
     assert "/api/freshness" in urls
 
 
+# ---------------------------------------------------------------------------
+# telemetry-historian tiles (ISSUE 20, mirrors the Freshness suite)
+
+
+def test_history_frame_updates_tiles_and_sparklines():
+    """History tiles: sample count, phase (with degraded highlight), RSS +
+    slope, fetch RTT, disk footprint, perfGuard regression count (with
+    highlight), and the three long-horizon sparklines."""
+    h = dashboard()
+    h.ws.server_open()
+    ctx = h.el("histRssSpark").ctx
+    ctx.calls.clear()
+    h.ws.server_message(frame(
+        jsonClass="History", samples=42, runId=7, phase="degraded",
+        rssMb=512.4, rssSlopeMbPerMin=1.257, rttMs=71.3, diskMb=3.5,
+        regressions=2, rss=[500.0, 506.0, 512.4], rtt=[70.0, 72.0, 71.3],
+        stageMs=[4.0, 4.5, 5.1],
+    ))
+    assert h.el("histSamples").text == "42"
+    assert h.el("histPhase").text == "degraded"
+    assert "degraded" in h.el("histPhase").class_set
+    assert h.el("histRss").text == "512"
+    assert h.el("histSlope").text == "1.26"
+    assert h.el("histRtt").text == "71.3"
+    assert h.el("histDisk").text == "3.5"
+    assert h.el("histRegressions").text == "2"
+    assert "degraded" in h.el("histRegressions").class_set
+    assert len(ctx.ops("stroke")) == 1
+    assert len(ctx.ops("lineTo")) == 2  # 3 points: 1 moveTo + 2 lineTo
+    texts = [args[0] for op, args in ctx.ops("fillText")]
+    assert any("512.4" in t for t in texts)  # last RSS value labeled
+    # a healthy, regression-free frame clears both highlights
+    h.ws.server_message(frame(
+        jsonClass="History", samples=43, runId=7, phase="healthy",
+        rssMb=512.0, rssSlopeMbPerMin=0.01, rttMs=70.0, diskMb=3.5,
+        regressions=0, rss=[512.0], rtt=[70.0], stageMs=[4.0],
+    ))
+    assert "degraded" not in h.el("histPhase").class_set
+    assert "degraded" not in h.el("histRegressions").class_set
+
+
+def test_history_empty_view_is_placeholder():
+    h = dashboard()
+    h.ws.server_open()
+    ctx = h.el("histRssSpark").ctx
+    ctx.calls.clear()
+    h.ws.server_message(frame(
+        jsonClass="History", samples=0, runId=0, phase="", rssMb=0.0,
+        rssSlopeMbPerMin=0.0, rttMs=0.0, diskMb=0.0, regressions=0,
+        rss=[], rtt=[], stageMs=[],
+    ))
+    assert h.el("histSamples").text == "—"
+    assert h.el("histRss").text == "—"
+    assert h.el("histPhase").text == "—"
+    assert h.el("histRegressions").text == "0"
+    assert len(ctx.ops("stroke")) == 0
+    texts = [args[0] for op, args in ctx.ops("fillText")]
+    assert any("waiting" in t for t in texts)
+
+
+def test_history_backfill_fetched_on_boot():
+    h = dashboard()
+    urls = [u for u, _ in h.fetches]
+    assert "/api/history" in urls
+
+
 def test_unknown_jsonclass_is_ignored():
     h = dashboard()
     h.ws.server_open()

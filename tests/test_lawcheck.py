@@ -428,6 +428,36 @@ def test_tw008_scoped_to_pack_hot_path(tmp_path):
     assert "TW008" not in rules_fired(report)
 
 
+def test_tw010_fires_on_historian_sampling_outside_the_seam(tmp_path):
+    """ISSUE 20 law: historian.sample() may run ONLY from the SessionStats
+    publish seam — a second sampling site pays new snapshot work on a hot
+    path (or invites a device fetch the counted-fetch law forbids)."""
+    report = run(tmp_path, {"twtml_tpu/streaming/context.py": (
+        "from twtml_tpu.telemetry import historian as _historian\n"
+        "def _lockstep_loop(self):\n"
+        "    _historian.sample()\n"                      # fires
+        "    _historian.get().sample()\n"                # fires too
+    )})
+    lines = [f.line for f in report.findings if f.rule == "TW010"]
+    assert lines == [3, 4]
+
+
+def test_tw010_quiet_in_the_seam_and_on_other_samples(tmp_path):
+    report = run(tmp_path, {
+        "twtml_tpu/telemetry/session_stats.py": (
+            "from . import historian as _historian\n"
+            "def publish_metrics(self):\n"
+            "    _historian.sample()\n"                  # THE seam
+        ),
+        "twtml_tpu/streaming/sources.py": (
+            "import random\n"
+            "def pick(xs):\n"
+            "    return random.sample(xs, 3)\n"          # not historian
+        ),
+    })
+    assert "TW010" not in rules_fired(report)
+
+
 def test_rule_registry_is_stable():
     rules = all_rules()
     ids = [r.id for r in rules]

@@ -251,6 +251,32 @@ def test_serving_roundtrip_and_default(server):
     assert "serving" in json.loads(exc_info.value.read())["error"]
 
 
+def test_history_roundtrip_and_default(server):
+    """Additive History messages (the telemetry-historian view): cached
+    last-value like Metrics, served at /api/history, unknown fields
+    dropped at the client edge (additive-wire discipline)."""
+    _, url, _ = server
+    import urllib.request
+
+    with urllib.request.urlopen(url + "/api/history", timeout=2) as resp:
+        empty = json.loads(resp.read())
+    assert empty["jsonClass"] == "History"
+    assert empty["samples"] == 0 and empty["rss"] == []
+
+    client = WebClient(url)
+    client.history({
+        "samples": 12, "runId": 3, "phase": "healthy", "rssMb": 300.5,
+        "rssSlopeMbPerMin": 0.4, "rttMs": 71.0, "diskMb": 1.2,
+        "regressions": 1, "rss": [299.0, 300.5], "rtt": [70.0, 71.0],
+        "stageMs": [4.2, 4.4], "someFutureField": "dropped",
+    })
+    with urllib.request.urlopen(url + "/api/history", timeout=2) as resp:
+        got = json.loads(resp.read())
+    assert got["samples"] == 12 and got["rssMb"] == 300.5
+    assert got["rss"] == [299.0, 300.5] and got["regressions"] == 1
+    assert "someFutureField" not in got
+
+
 def test_http_post_broadcasts_to_websockets(server):
     _, url, _ = server
     ws_url = url.replace("http://", "ws://") + "/api"

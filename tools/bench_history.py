@@ -1,0 +1,146 @@
+"""Telemetry-historian overhead check (ISSUE 20): the full --history plane
+— per-publish sample framing (CRC32 journal frames, stage-clock deltas,
+registry snapshot, phase tracking, perfGuard window) written to real
+segments — measured against a no-historian control in the per-batch-
+telemetry regime (the regime where per-batch host costs bind;
+BENCHMARKS.md).
+
+Arms (interleaved single passes + paired per-round ratios, the house
+method — tools/pairedbench.py):
+
+- off  : the consume loop never touches the historian — the exact HEAD
+         hot path (``--history off`` uninstalls the module hook, so
+         production pays even less: one no-op call per stats tick);
+- hist : ``historian.sample()`` once per delivered batch (the stats ticks
+         run every batch in this regime, so this is the WORST-CASE
+         sampling cadence; production samples every METRICS_EVERY=8
+         updates at most).
+
+Both arms dispatch the SAME model/program — the historian is host-side
+only (zero added fetches, zero collectives; the counted test in
+tests/test_history.py proves it), so any delta is pure Python + buffered
+disk writes. Passes the acceptance gate when the paired ratio (off/hist)
+is >= 0.97x (the ISSUE's <= 3% budget).
+
+Usage: python tools/bench_history.py [--tweets N] [--batch B] [--budget S]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    n_tweets, batch, budget = 65536, 2048, 120.0
+    i = 0
+    while i < len(args):
+        if args[i] == "--tweets":
+            n_tweets = int(args[i + 1]); i += 2
+        elif args[i] == "--batch":
+            batch = int(args[i + 1]); i += 2
+        elif args[i] == "--budget":
+            budget = float(args[i + 1]); i += 2
+        else:
+            raise SystemExit(f"unknown flag {args[i]!r}")
+
+    import jax
+
+    from twtml_tpu.apps.common import FetchPipeline
+    from twtml_tpu.features.batch import pack_batch
+    from twtml_tpu.features.featurizer import Featurizer
+    from twtml_tpu.models import StreamingLinearRegressionWithSGD
+    from twtml_tpu.streaming.sources import SyntheticSource
+    from twtml_tpu.telemetry import historian as _historian
+
+    feat = Featurizer(now_ms=1785320000000)
+    statuses = list(SyntheticSource(total=n_tweets, seed=3).produce())
+    chunks = [statuses[i : i + batch] for i in range(0, len(statuses), batch)]
+    r_batches = [
+        feat.featurize_batch_ragged(c, row_bucket=batch, pre_filtered=True)
+        for c in chunks
+    ]
+
+    # one segment directory for the whole run: the hist arm appends to real
+    # segments round over round (rotation included), exactly like a long
+    # production run; the off arm never touches the configured historian,
+    # which is the HEAD hot path (no call at all)
+    hist_dir = tempfile.mkdtemp(prefix="twtml-bench-history-")
+    _historian.configure(
+        hist_dir, max_mb=64, perf_guard=True, run_id=1, fingerprint="bench",
+    )
+
+    def consume_off(out, b, t, at_boundary=True):
+        float(out.count); float(out.mse)
+        float(out.real_stdev); float(out.pred_stdev)
+        _ = out.predictions[0]
+
+    def consume_hist(out, b, t, at_boundary=True):
+        consume_off(out, b, t, at_boundary)
+        _historian.sample()
+
+    model = StreamingLinearRegressionWithSGD()
+    seen = set()
+    for rb in r_batches:  # warm every packed layout both arms dispatch
+        key = (rb.units.shape, str(rb.units.dtype), rb.row_len)
+        if key not in seen:
+            seen.add(key)
+            float(model.step(pack_batch(rb)).mse)
+
+    def run_pass(consume):
+        model.reset()
+        t0 = time.perf_counter()
+        pipe = FetchPipeline(model, consume, depth=8, pack=True)
+        for rb in r_batches:
+            pipe.on_batch(rb, 0.0)
+        pipe.flush()
+        return time.perf_counter() - t0
+
+    def off_pass():
+        return run_pass(consume_off)
+
+    def hist_pass():
+        return run_pass(consume_hist)
+
+    off_pass(); hist_pass()  # warm both arms' code paths
+
+    from tools.pairedbench import (
+        best_median_rate, paired_ratio_median, run_rounds,
+    )
+
+    times = run_rounds({"off": off_pass, "hist": hist_pass}, budget)
+    view = _historian.last_history() or {}
+    disk_mb = _historian.get().disk_bytes() / 1e6 if _historian.get() else 0.0
+    _historian.uninstall()
+    shutil.rmtree(hist_dir, ignore_errors=True)
+    out = {
+        "regime": "history-overhead", "batch": batch,
+        "tweets": n_tweets, "backend": jax.default_backend(),
+        "rounds": len(times["off"]),
+        "samples_written": view.get("samples", 0),
+        "segments_disk_mb": round(disk_mb, 2),
+    }
+    for name, ts in times.items():
+        best, median = best_median_rate(ts, n_tweets)
+        out[name] = {
+            "tweets_per_sec_best": best,
+            "tweets_per_sec_median": median,
+        }
+    out["hist"]["paired_vs_off"] = paired_ratio_median(
+        times["off"], times["hist"]
+    )
+    out["neutral"] = out["hist"]["paired_vs_off"] >= 0.97
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

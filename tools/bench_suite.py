@@ -650,6 +650,12 @@ def main(argv=None) -> None:
             n_real = 0
     env = dict(os.environ, TWTML_REAL_DEVICES=str(n_real))
 
+    # run provenance (ISSUE 20): ONE monotonic run id for the whole suite
+    # invocation (each config line carries its own fingerprint) so suite
+    # rows join the telemetry historian's segments run-over-run
+    from twtml_tpu.utils.runid import config_fingerprint, next_run_id
+
+    suite_run_id = next_run_id()
     lines = []
     for name in selected:
         proc = None
@@ -669,6 +675,9 @@ def main(argv=None) -> None:
                 else ""
             )
             rec = {"config": name, "error": detail or repr(exc)}
+        rec["run_id"] = suite_run_id
+        rec["config_fingerprint"] = config_fingerprint(
+            {"config": name, "tweets": n_tweets, "batch": batch_size})
         lines.append(rec)
         print(json.dumps(rec), flush=True)
     if out_path:

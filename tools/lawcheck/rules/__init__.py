@@ -49,6 +49,7 @@ def all_rules() -> "list[Rule]":
     from .arena import TW008WireArena
     from .device import TW004Scatter
     from .docs import TW007FlagDocs
+    from .historian import TW010HistorianSeam
     from .host import TW005SilentSwallow, TW006WallClock
     from .journal import TW009JournalSeam
     from .transport import TW001BackendInit, TW002FetchSeam, TW003ThreadPut
@@ -63,6 +64,7 @@ def all_rules() -> "list[Rule]":
         TW007FlagDocs(),
         TW008WireArena(),
         TW009JournalSeam(),
+        TW010HistorianSeam(),
     ]
 
 

@@ -64,6 +64,8 @@ def summarize(doc: dict, tail_events: int = 12) -> dict:
         ))
     }
     hosts = doc.get("hosts") or {}
+    history = doc.get("history") or {}
+    hist_samples = history.get("samples") or []
     return {
         "reason": doc["reason"],
         "time_unix": doc["time_unix"],
@@ -81,6 +83,19 @@ def summarize(doc: dict, tail_events: int = 12) -> dict:
             "stage": hosts.get("stage", ""),
             "skew_ms": hosts.get("skew_ms", 0.0),
         } if hosts else None,
+        # the minutes BEFORE death (ISSUE 20): the historian tail the
+        # blackbox folded in — RSS/RTT trajectory and phase flips leading
+        # up to the crash, not just the event ring
+        "history": {
+            "run_id": history.get("run_id"),
+            "samples": len(hist_samples),
+            "transitions": len(history.get("transitions") or []),
+            "rss_mb": [s.get("rss_mb", 0.0) for s in hist_samples],
+            "rtt_ms": [s.get("rtt_ms", 0.0) for s in hist_samples],
+            "last_phase": (
+                hist_samples[-1].get("phase", "") if hist_samples else ""
+            ),
+        } if hist_samples else None,
         "tail": events[-tail_events:],
     }
 
@@ -115,6 +130,19 @@ def render(s: dict) -> str:
         out.append(
             f"  lockstep straggler: host {st['host']} · {st['stage']} "
             f"(tick skew {st['skew_ms']} ms)"
+        )
+    if s.get("history"):
+        h = s["history"]
+        rss = h["rss_mb"]
+        rss_arc = (
+            f"{rss[0]:.0f} -> {rss[-1]:.0f} MB" if rss else "?"
+        )
+        out.append(
+            f"  history tail (run {h['run_id']}): {h['samples']} sample(s)"
+            f" before death · rss {rss_arc} · last phase "
+            f"{h['last_phase'] or '?'} · {h['transitions']} phase flip(s)"
+            " — full timeline: tools/history_report.py on the bundle or"
+            " the run's history directory"
         )
     out.append("  last events:")
     for ev in s["tail"]:

@@ -23,9 +23,20 @@ r17 additions (ISSUE 14):
   recorded side by side, are the arena's RSS evidence (BENCHMARKS.md
   "One-pass wire assembly (r17)").
 
+r22 addition (ISSUE 20): the soak feeds the telemetry historian — one
+``historian.sample()`` per pass into ``--historyDir`` (default
+``soak_history/`` in the repo root; ``--historyDir off`` disables). The
+segments are the soak's durable black box: a SIGKILLed soak leaves CRC-
+valid frames behind, and ``tools/history_report.py soak_history/``
+reconstructs the RSS slope and tunnel-phase intervals from the leftovers
+alone. The JSON line reports the segment-derived slope next to the
+in-process one — the two estimators must agree, which is the historian's
+own correctness check.
+
 Usage: python tools/soak.py [--minutes M] [--tweets N]
        [--arena on|off] [--wireAssemble auto|on|off]
        [--maxRssSlopeMbPerMin X] [--configs both|dense|hash2e18]
+       [--historyDir DIR|off]
 Prints one JSON line at the end (exit 1 on a slope breach).
 
 ``--configs dense`` keeps only the dense ragged arm — the wire-heavy
@@ -62,6 +73,7 @@ def main(argv=None) -> None:
     arena_on, assemble_mode = True, "auto"
     max_slope = None
     configs = "both"
+    history_dir = os.path.join(REPO, "soak_history")
     i = 0
     while i < len(args):
         if args[i] == "--minutes":
@@ -76,6 +88,9 @@ def main(argv=None) -> None:
             max_slope = float(args[i + 1]); i += 2
         elif args[i] == "--configs":
             configs = args[i + 1]; i += 2
+        elif args[i] == "--historyDir":
+            history_dir = None if args[i + 1] == "off" else args[i + 1]
+            i += 2
         else:
             raise SystemExit(f"unknown flag {args[i]!r}")
 
@@ -90,6 +105,22 @@ def main(argv=None) -> None:
 
     _assemble.configure(assemble_mode)
     _arena.set_enabled(arena_on)
+
+    # durable long-horizon record (ISSUE 20): one historian sample per
+    # pass; the segments survive a SIGKILL and history_report reconstructs
+    # phase intervals + RSS slope from the leftovers alone
+    from twtml_tpu.telemetry import historian as _historian
+    from twtml_tpu.utils.runid import config_fingerprint, next_run_id
+
+    if history_dir:
+        _historian.configure(
+            history_dir, max_mb=64,
+            run_id=next_run_id(),
+            fingerprint=config_fingerprint({
+                "tool": "soak", "tweets": n_tweets, "configs": configs,
+                "arena": arena_on, "wire_assemble": assemble_mode,
+            }),
+        )
 
     statuses = list(SyntheticSource(total=n_tweets, seed=3).produce())
     # per-pass pack leases, retired at the pass's completion fetch (every
@@ -160,9 +191,20 @@ def main(argv=None) -> None:
             rss_samples.append(
                 (time.perf_counter() - t_start, rss_mb())
             )
+            _historian.sample()  # no-op when --historyDir off
     rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
     slope = round(_slope_mb_per_min(rss_samples), 3)
     breach = max_slope is not None and slope > max_slope
+    # segment-derived slope: re-read what actually hit disk and run the
+    # same estimator over it — the historian's own durability check (a
+    # disagreement means samples were lost or mis-framed)
+    history_slope = None
+    if history_dir:
+        _historian.stamp_baseline()  # clean soak end → next run gets deltas
+        _historian.uninstall()
+        history_slope = round(
+            _historian.rss_slope(_historian.read_series(history_dir)), 3
+        )
     from twtml_tpu.features.arena import get_arena
 
     print(json.dumps({
@@ -181,6 +223,8 @@ def main(argv=None) -> None:
         "wire_assemble": assemble_mode,
         "arena_stats": get_arena().stats(),
         "rss_watchdog_warnings": watchdog.warn_count,
+        "history_dir": history_dir,
+        "history_rss_slope_mb_per_min": history_slope,
         "backend": jax.default_backend(),
     }))
     if breach:

@@ -90,6 +90,11 @@ BACKLOG = (
               "--maxRssSlopeMbPerMin", "10"], 1800,
      "the axon RSS retention under the arena (r17): slope gate proves "
      "the pooled transfer buffers bound it (ROADMAP item 5)"),
+    ("history", ["tools/bench_history.py", "--budget", "300"], 1200,
+     "r22 telemetry historian on the real tunnel: the <=3% overhead "
+     "gate with segment writes co-scheduled against live upload RTT, "
+     "plus real healthy/degraded phase intervals in the segments "
+     "(BENCHMARKS 'Historian overhead')"),
 )
 
 RETUNE_NOTES = """\

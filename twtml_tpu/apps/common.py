@@ -332,6 +332,53 @@ def install_journal(conf) -> None:
     )
 
 
+def install_historian(conf) -> None:
+    """``--history`` wiring shared by every entry point: open this host's
+    telemetry historian (telemetry/historian.py) so the SessionStats
+    publish seam samples into it. Per-host directories under
+    ``--checkpointDir`` (the journal's keying: elastic uid, or the launch
+    process id) — a restarted host appends after its own recovered tail,
+    so one directory accumulates a multi-run timeline and the perfGuard
+    baseline round-trips between runs. Call after ``init_distributed``."""
+    if not conf.effective_history():
+        # a historian left installed by an earlier run() in the same
+        # process (tests, embedded uses) would sample THIS run's publish
+        # ticks too — --history off must be bit-exact pre-historian
+        from ..telemetry import historian as _historian
+
+        _historian.uninstall()
+        return
+    if not getattr(conf, "checkpointDir", ""):
+        raise SystemExit(
+            "--history on requires --checkpointDir: the historian "
+            "segments and the --perfGuard baseline live under it (use "
+            "--history auto to follow the checkpoint flag)"
+        )
+    import os as _os
+
+    from ..parallel.elastic import get_runtime as _get_elastic_runtime
+    from ..telemetry import historian as _historian
+    from ..utils.runid import config_fingerprint, next_run_id
+
+    runtime = _get_elastic_runtime()
+    if runtime is not None:
+        suffix = f"-u{runtime.uid}"
+    else:
+        import jax
+
+        suffix = (
+            f"-p{jax.process_index()}" if jax.process_count() > 1 else ""
+        )
+    _historian.configure(
+        _os.path.join(conf.checkpointDir, f"history{suffix}"),
+        max_mb=int(getattr(conf, "historyMaxMb", 256) or 256),
+        perf_guard=getattr(conf, "perfGuard", "warn") == "warn",
+        guard_ratio=float(getattr(conf, "perfGuardRatio", 1.5) or 1.5),
+        run_id=next_run_id(),
+        fingerprint=config_fingerprint(conf),
+    )
+
+
 def build_source(
     conf,
     allow_block: bool = False,

@@ -41,6 +41,7 @@ from .common import (
     init_distributed,
     install_blackbox,
     install_chaos,
+    install_historian,
     install_trace,
     select_backend,
 )
@@ -111,6 +112,7 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
     install_trace(conf)
     install_chaos(conf)
     install_blackbox(conf)  # crash flight recorder (apps/common)
+    install_historian(conf)  # telemetry historian (--history, apps/common)
     multihost = jax.process_count() > 1
     if multihost and conf.batchBucket <= 0:
         raise SystemExit(
@@ -341,6 +343,12 @@ def run(conf: ConfArguments, max_batches: int = 0, wall_clock: bool = True) -> d
 
         pipeline_trace.uninstall()  # flush + close the --trace file
         ckpt.final_save(totals)
+        from ..telemetry import historian as _historian_mod
+
+        # perfGuard baseline stamps on CLEAN shutdown only
+        if not ssc.failed:
+            _historian_mod.stamp_baseline()
+        _historian_mod.uninstall()
     if ssc.failed:
         raise RuntimeError(
             "run aborted by a runtime guard — lockstep peer loss or a fetch "

@@ -34,6 +34,7 @@ from .common import (  # noqa: F401
     init_distributed,
     install_blackbox,
     install_chaos,
+    install_historian,
     install_journal,
     install_trace,
     select_backend,
@@ -67,6 +68,9 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     # durable intake journal (--journal, auto-on with --checkpointDir):
     # every recovery path below replays from it instead of counting loss
     install_journal(conf)
+    # telemetry historian (--history, auto-on with --checkpointDir):
+    # durable long-horizon time series at the stats-publish cadence
+    install_historian(conf)
 
     log.info("Initializing streaming context... %s sec/batch", conf.seconds)
     ssc = StreamingContext(
@@ -218,11 +222,18 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         pipeline_trace.uninstall()  # flush + close the --trace file
         ckpt.final_save(totals)
         from ..streaming import journal as _journal_mod
+        from ..telemetry import historian as _historian_mod
 
         # after the final save (it stamps the journal cursor): close the
         # segment files and clear the module face so a later run() in the
         # same process starts clean
         _journal_mod.uninstall()
+        # perfGuard baseline stamps on CLEAN shutdown only — a guard-
+        # aborted run's degraded stage costs must not become the next
+        # run's "healthy" baseline
+        if not ssc.failed:
+            _historian_mod.stamp_baseline()
+        _historian_mod.uninstall()
     if ssc.failed:
         # elastic runs leave via a hard exit either way (abandoned-epoch
         # teardown during interpreter finalization is unsafe)

@@ -38,6 +38,7 @@ from .common import (
     init_distributed,
     install_blackbox,
     install_chaos,
+    install_historian,
     install_journal,
     install_trace,
     journal_boot_replay,
@@ -67,6 +68,7 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
     install_chaos(conf)
     install_blackbox(conf)  # crash flight recorder (apps/common)
     install_journal(conf)  # durable intake journal (--journal, apps/common)
+    install_historian(conf)  # telemetry historian (--history, apps/common)
 
     ssc = StreamingContext(
         batch_interval=conf.seconds,
@@ -183,11 +185,16 @@ def run(conf: ConfArguments, max_batches: int = 0) -> dict:
         pipeline_trace.uninstall()  # flush + close the --trace file
         ckpt.final_save(totals)
         from ..streaming import journal as _journal_mod
+        from ..telemetry import historian as _historian_mod
 
         # after the final save (it stamps the journal cursor): close the
         # segment files and clear the module face so a later run() in the
         # same process starts clean
         _journal_mod.uninstall()
+        # perfGuard baseline stamps on CLEAN shutdown only
+        if not ssc.failed:
+            _historian_mod.stamp_baseline()
+        _historian_mod.uninstall()
     if ssc.failed:
         elastic_exit(failed=True)
         raise RuntimeError(

@@ -152,6 +152,29 @@ class ConfArguments:
             raise ValueError(
                 f"journalMaxMb must be positive, got {self.journalMaxMb}"
             )
+        # telemetry historian (r22): durable long-horizon time series at
+        # the stats-publish cadence + cross-run perf regression sentinel
+        # (telemetry/historian.py)
+        self.history: str = conf.get("history", "auto")
+        if self.history not in ("auto", "on", "off"):
+            raise ValueError(
+                f"history must be 'auto', 'on' or 'off', got {self.history!r}"
+            )
+        self.historyMaxMb: int = int(conf.get("historyMaxMb", "256"))
+        if self.historyMaxMb <= 0:
+            raise ValueError(
+                f"historyMaxMb must be positive, got {self.historyMaxMb}"
+            )
+        self.perfGuard: str = conf.get("perfGuard", "warn")
+        if self.perfGuard not in ("warn", "off"):
+            raise ValueError(
+                f"perfGuard must be 'warn' or 'off', got {self.perfGuard!r}"
+            )
+        self.perfGuardRatio: float = float(conf.get("perfGuardRatio", "1.5"))
+        if self.perfGuardRatio <= 1.0:
+            raise ValueError(
+                f"perfGuardRatio must be > 1.0, got {self.perfGuardRatio}"
+            )
         self.profileDir: str = conf.get("profileDir", "")
         self.trace: str = conf.get("trace", "")
         self.traceMaxMb: int = int(conf.get("traceMaxMb", "256"))
@@ -398,6 +421,30 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
                                                verified checkpoint covers them, and the
                                                oldest are dropped (loudly, counted) past
                                                this cap. Default: {self.journalMaxMb}
+  --history <auto|on|off>                      Telemetry historian (telemetry/historian.py):
+                                               durable CRC-framed time-series segments
+                                               sampled at the EXISTING stats-publish cadence
+                                               (zero added fetches/collectives) with
+                                               health-phase intervals — long-horizon RSS
+                                               slope, per-phase RTT/throughput trends, and
+                                               the --perfGuard baseline survive the process
+                                               (tools/history_report.py reads the leftovers).
+                                               auto = on iff --checkpointDir is set; 'off'
+                                               is bit-exact pre-historian behavior.
+                                               Default: {self.history}
+  --historyMaxMb <int MB>                      Historian disk ceiling; the oldest segments
+                                               are dropped (loudly, counted) past this cap.
+                                               Default: {self.historyMaxMb}
+  --perfGuard <warn|off>                       Cross-run perf regression sentinel: healthy-
+                                               phase per-stage publish-tick medians stamp a
+                                               baseline.json at clean shutdown; the next run
+                                               raises ONE warn-only blackbox event +
+                                               perf.regressions counter per stage episode
+                                               when a stage sustains above
+                                               --perfGuardRatio x baseline for a full
+                                               window. Never aborts. Default: {self.perfGuard}
+  --perfGuardRatio <float>                     Sustained-regression threshold for
+                                               --perfGuard. Default: {self.perfGuardRatio}
   --profileDir <path>                          Enable jax.profiler traces
   --trace <path.trace>                         Write a Chrome-trace-event pipeline trace
                                                (Perfetto-loadable): per-batch stage spans
@@ -749,6 +796,22 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
             self.journalMaxMb = int(take())
             if self.journalMaxMb <= 0:
                 self.printUsage(1)
+        elif flag == "--history":
+            self.history = take()
+            if self.history not in ("auto", "on", "off"):
+                self.printUsage(1)
+        elif flag == "--historyMaxMb":
+            self.historyMaxMb = int(take())
+            if self.historyMaxMb <= 0:
+                self.printUsage(1)
+        elif flag == "--perfGuard":
+            self.perfGuard = take()
+            if self.perfGuard not in ("warn", "off"):
+                self.printUsage(1)
+        elif flag == "--perfGuardRatio":
+            self.perfGuardRatio = float(take())
+            if self.perfGuardRatio <= 1.0:
+                self.printUsage(1)
         elif flag == "--profileDir":
             self.profileDir = take()
         elif flag == "--trace":
@@ -970,6 +1033,19 @@ Usage: python -m twtml_tpu.apps.linear_regression [options]
         bit-exact pre-journal behavior: every hook no-ops."""
         if self.journal != "auto":
             return self.journal == "on"
+        return bool(self.checkpointDir)
+
+    def effective_history(self) -> bool:
+        """Resolve ``--history auto`` (the default): the telemetry
+        historian is ON exactly when ``--checkpointDir`` is set — its
+        segments and the perfGuard baseline live under the checkpoint
+        directory, so without one there is nowhere durable to append.
+        Explicit ``on``/``off`` wins; explicit ``on`` without a checkpoint
+        directory is rejected at install (apps/common.install_historian).
+        ``off`` is bit-exact pre-historian behavior: the sample hook
+        no-ops (tests/test_history.py byte-compares weights)."""
+        if self.history != "auto":
+            return self.history == "on"
         return bool(self.checkpointDir)
 
     def effective_max_queue_rows(self) -> int:

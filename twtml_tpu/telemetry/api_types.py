@@ -224,10 +224,35 @@ class Fleet:
     json_class = "Fleet"
 
 
+@dataclass
+class History:
+    """Telemetry-historian view — an ADDITIVE message type (no reference
+    equivalent). Published by telemetry/historian.py from its in-memory
+    tail ring (the durable segments never get read on the hot path): the
+    long-horizon RSS / fetch-RTT / per-tick stage-cost sparklines, the
+    least-squares RSS slope (the soak estimator, live), the current
+    health phase, historian disk usage, and the perfGuard regression
+    count. Legacy dashboards ignore it like the other additive types."""
+
+    samples: int = 0
+    runId: int = 0
+    phase: str = ""
+    rssMb: float = 0.0
+    rssSlopeMbPerMin: float = 0.0
+    rttMs: float = 0.0
+    diskMb: float = 0.0
+    regressions: int = 0
+    rss: list = field(default_factory=list)
+    rtt: list = field(default_factory=list)
+    stageMs: list = field(default_factory=list)
+
+    json_class = "History"
+
+
 TYPES = {"Config": Config, "Stats": Stats, "Series": Series,
          "Metrics": Metrics, "Hosts": Hosts, "Tenants": Tenants,
          "ModelHealth": ModelHealth, "Serving": Serving, "Fleet": Fleet,
-         "Freshness": Freshness}
+         "Freshness": Freshness, "History": History}
 
 
 def encode(obj: Config | Stats) -> str:

@@ -87,6 +87,7 @@ class FlightRecorder:
 
     # -- the bundle ----------------------------------------------------------
     def bundle(self, reason: str) -> dict:
+        from . import historian as _historian
         from . import metrics as _metrics
         from . import sideband as _sideband
 
@@ -107,6 +108,9 @@ class FlightRecorder:
             "metrics": _metrics.get_registry().snapshot(),
             "health": _metrics.get_health_monitor().summary(),
             "hosts": _sideband.last_hosts(),
+            # the minutes BEFORE death: the historian's in-memory tail
+            # (samples + phase transitions), None when --history off
+            "history": _historian.bundle_tail(),
         }
 
     def dump(self, reason: str, out_dir: "str | None" = None,

@@ -226,6 +226,15 @@ class SessionStats:
             )
         except Exception:
             pass
+        # telemetry historian (ISSUE 20): THE sampling seam — lawcheck
+        # TW010 pins historian.sample() to this method. It snapshots the
+        # registry/health/stage views this publish tick already computed
+        # (pure host reads, zero device traffic); no-op when --history off.
+        # BEFORE the breaker gate: the historian writes to local disk, so a
+        # dead dashboard must not stop the durable timeline
+        from . import historian as _historian
+
+        _historian.sample()
         if not self._web_breaker.allow():
             return
         try:
@@ -321,3 +330,11 @@ class SessionStats:
             except Exception:
                 self._web_breaker.record_failure()
                 log.debug("web.freshness failed", exc_info=True)
+        hview = _historian.last_history()
+        if hview is not None and self._web_breaker.allow():
+            try:
+                self.web.history(hview)
+                self._web_breaker.record_success()
+            except Exception:
+                self._web_breaker.record_failure()
+                log.debug("web.history failed", exc_info=True)

@@ -12,8 +12,8 @@ from __future__ import annotations
 import urllib.request
 
 from .api_types import (
-    Config, Fleet, Freshness, Hosts, Metrics, ModelHealth, Series, Serving,
-    Stats, Tenants, decode, encode,
+    Config, Fleet, Freshness, History, Hosts, Metrics, ModelHealth, Series,
+    Serving, Stats, Tenants, decode, encode,
 )
 
 DEFAULT_SERVER = "http://localhost:8888"  # WebClient.scala:13
@@ -132,6 +132,13 @@ class WebClient:
         tile row (additive message)."""
         known = Freshness.__dataclass_fields__
         self._post(Freshness(**{k: v for k, v in view.items() if k in known}))
+
+    def history(self, view: dict) -> None:
+        """Push the telemetry-historian view (telemetry/historian.py
+        ``last_history()``) for the dashboard's "history · long horizon"
+        sparkline tile row (additive message)."""
+        known = History.__dataclass_fields__
+        self._post(History(**{k: v for k, v in view.items() if k in known}))
 
     def fleet(self, view: dict) -> None:
         """Push the read-fleet view (``FleetRouter.stats()``) for the

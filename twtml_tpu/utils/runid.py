@@ -1,0 +1,74 @@
+"""Monotonic run ids + config fingerprints (ISSUE 20 satellite).
+
+Nothing linked a BENCH_*.json row to the run that produced it, or one
+historian segment to the next run appending after it. Two small joins fix
+that:
+
+- ``next_run_id()`` — a machine-local monotonically-increasing integer,
+  persisted in a small counter file under an ``fcntl`` lock (concurrent
+  bench subprocesses each get a distinct id). ``TWTML_RUN_ID_FILE``
+  overrides the location (tests; per-checkout counters).
+- ``config_fingerprint(conf_or_dict)`` — a short stable hash over the
+  SCALAR config values, so "same config, different run" and "same run id
+  family, different config" are both one string comparison across bench
+  rows, historian run headers, and perfGuard baselines.
+
+Host-side stdlib only; no jax anywhere near this module.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tempfile
+
+ENV_RUN_ID_FILE = "TWTML_RUN_ID_FILE"
+
+
+def _counter_path() -> str:
+    override = os.environ.get(ENV_RUN_ID_FILE, "")
+    if override:
+        return override
+    return os.path.join(tempfile.gettempdir(), "twtml-run-id")
+
+
+def next_run_id() -> int:
+    """Allocate the next machine-local run id (1, 2, 3, ...). The counter
+    file is read-increment-written under an exclusive ``flock`` so parallel
+    launches never collide; an unreadable counter restarts at 1 rather than
+    failing the run (ids are a join key, not a correctness invariant)."""
+    path = _counter_path()
+    try:
+        import fcntl
+
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            raw = os.read(fd, 64).decode("ascii", "replace").strip()
+            try:
+                current = int(raw)
+            except ValueError:
+                current = 0
+            nxt = current + 1
+            os.lseek(fd, 0, os.SEEK_SET)
+            os.ftruncate(fd, 0)
+            os.write(fd, str(nxt).encode("ascii"))
+            return nxt
+        finally:
+            os.close(fd)  # releases the flock too
+    except OSError:
+        return 1
+
+
+def config_fingerprint(conf) -> str:
+    """12-hex-char stable hash over the scalar config values. Accepts a
+    Config-like object (``vars()`` is taken) or a plain dict; private
+    attrs, callables and non-scalars are skipped so the fingerprint only
+    moves when a knob a human set moves."""
+    d = conf if isinstance(conf, dict) else vars(conf)
+    items = sorted(
+        (k, v) for k, v in d.items()
+        if not k.startswith("_") and isinstance(v, (str, int, float, bool))
+    )
+    blob = "\n".join(f"{k}={v!r}" for k, v in items).encode("utf-8")
+    return hashlib.sha256(blob).hexdigest()[:12]

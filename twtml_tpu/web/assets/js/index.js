@@ -375,6 +375,66 @@
     drawFreshSpark(json.watermark || []);
   }
 
+  function drawHistorySpark(canvasId, values, label, unit, color) {
+    // one historian sparkline tile (History.rss / .rtt / .stageMs windows)
+    const canvas = document.getElementById(canvasId);
+    const ctx = canvas.getContext("2d");
+    const w = (canvas.width = canvas.clientWidth || 800);
+    const h = (canvas.height = canvas.clientHeight || 44);
+    ctx.clearRect(0, 0, w, h);
+    if (!values.length) {
+      ctx.fillStyle = "rgba(128,128,128,0.6)";
+      ctx.font = "11px system-ui";
+      ctx.fillText(label + " — waiting for historian samples…", 8, 14);
+      return;
+    }
+    let lo = Math.min(...values), hi = Math.max(...values);
+    if (hi === lo) { hi = lo + 1; }
+    ctx.beginPath();
+    ctx.strokeStyle = color;
+    ctx.lineWidth = 1.4;
+    values.forEach((v, i) => {
+      const x = (i / Math.max(values.length - 1, 1)) * (w - 10) + 5;
+      const y = h - 6 - ((v - lo) / (hi - lo)) * (h - 12);
+      i ? ctx.lineTo(x, y) : ctx.moveTo(x, y);
+    });
+    ctx.stroke();
+    ctx.fillStyle = "rgba(128,128,128,0.8)";
+    ctx.font = "10px system-ui";
+    ctx.fillText(
+      label + " " + values[values.length - 1].toFixed(1) + " " + unit, 6, 12
+    );
+  }
+
+  function onHistory(json) {
+    // telemetry-historian tiles (telemetry/historian.py view): long-horizon
+    // RSS / fetch-RTT / per-tick stage-cost sparklines + the perfGuard
+    // regression count, from the durable time-series tail
+    const live = Number(json.samples) > 0;
+    const num = (v, d) => (live ? Number(v).toFixed(d) : "—");
+    document.getElementById("histSamples").textContent =
+      live ? String(json.samples) : "—";
+    document.getElementById("histPhase").textContent = json.phase || "—";
+    document.getElementById("histRss").textContent = num(json.rssMb, 0);
+    document.getElementById("histSlope").textContent =
+      num(json.rssSlopeMbPerMin, 2);
+    document.getElementById("histRtt").textContent = num(json.rttMs, 1);
+    document.getElementById("histDisk").textContent = num(json.diskMb, 1);
+    const regress = Number(json.regressions || 0);
+    const regressEl = document.getElementById("histRegressions");
+    regressEl.textContent = String(regress);
+    regressEl.classList.toggle("degraded", regress > 0);
+    document.getElementById("histPhase").classList.toggle(
+      "degraded", json.phase === "degraded"
+    );
+    drawHistorySpark("histRssSpark", json.rss || [], "host rss", "mb",
+                     "rgb(180, 83, 9)");
+    drawHistorySpark("histRttSpark", json.rtt || [], "fetch rtt", "ms",
+                     "rgb(29, 78, 216)");
+    drawHistorySpark("histStageSpark", json.stageMs || [],
+                     "stage cost / tick", "ms", "rgb(107, 33, 168)");
+  }
+
   function onMessage(json) {
     switch (json.jsonClass) {
       case "Config": onConfig(json); break;
@@ -386,6 +446,7 @@
       case "Serving": onServing(json); break;
       case "Fleet": onFleet(json); break;
       case "Freshness": onFreshness(json); break;
+      case "History": onHistory(json); break;
       case "Series":
         // live frames buffer until the history backfill lands (ordering)
         if (!backfilled) pendingSeries.push(json);
@@ -420,6 +481,8 @@
     fetch("/api/fleet").then((r) => r.json()).then(onFleet).catch(() => {});
     // freshness-plane backfill (batches 0 until a training run publishes)
     fetch("/api/freshness").then((r) => r.json()).then(onFreshness).catch(() => {});
+    // historian backfill (samples 0 until a --history run publishes)
+    fetch("/api/history").then((r) => r.json()).then(onHistory).catch(() => {});
     // backfill the chart from the server's rolling series window, then
     // apply any live frames that arrived while the fetch was in flight
     const flush = () => {

@@ -17,8 +17,8 @@ import collections
 import json as _json
 
 from ..telemetry.api_types import (
-    Config, Fleet, Freshness, Hosts, Metrics, ModelHealth, Series, Serving,
-    Stats, Tenants, decode, encode,
+    Config, Fleet, Freshness, History, Hosts, Metrics, ModelHealth, Series,
+    Serving, Stats, Tenants, decode, encode,
 )
 from ..utils import get_logger
 
@@ -42,6 +42,7 @@ class ApiCache:
         self._serving = Serving()
         self._fleet = Fleet()
         self._freshness = Freshness()
+        self._history = History()
         self._series: collections.deque[Series] = collections.deque(
             maxlen=SERIES_WINDOW
         )
@@ -80,6 +81,10 @@ class ApiCache:
         """Latest end-to-end freshness view (in-memory only, like Stats)."""
         return encode(self._freshness)
 
+    def history(self) -> str:
+        """Latest telemetry-historian view (in-memory only, like Stats)."""
+        return encode(self._history)
+
     def series(self) -> str:
         """Recent Series messages as a JSON array (chart backfill for
         dashboards that connect mid-run; in-memory only, like Stats)."""
@@ -116,6 +121,8 @@ class ApiCache:
             self._fleet = data
         elif isinstance(data, Freshness):
             self._freshness = data
+        elif isinstance(data, History):
+            self._history = data
         elif isinstance(data, Series):
             self._series.append(data)
         else:

@@ -114,6 +114,10 @@ class Server:
         return web.Response(text=self.cache.freshness(),
                             content_type="application/json")
 
+    async def _get_history(self, request: web.Request) -> web.StreamResponse:
+        return web.Response(text=self.cache.history(),
+                            content_type="application/json")
+
     async def _get_fleet(self, request: web.Request) -> web.StreamResponse:
         # a router process answers LIVE (the view is plain host bookkeeping
         # under a lock); any other process serves the cached additive view
@@ -284,6 +288,7 @@ class Server:
         app.router.add_get("/api/serving", self._get_serving)  # serve plane
         app.router.add_get("/api/fleet", self._get_fleet)  # read fleet
         app.router.add_get("/api/freshness", self._get_freshness)  # e2e lag
+        app.router.add_get("/api/history", self._get_history)  # historian
         app.router.add_post("/api/predict", self._post_predict)  # front door
         app.router.add_get("/", self._index)
         app.router.add_get("/{path:.+}", self._static)
